@@ -1,0 +1,112 @@
+"""Headline benchmark: pipelined ResNet50 inference throughput vs. the
+single-chip jit baseline.
+
+Mirrors the reference's measurement protocol — timed-window throughput of
+batch-1 streaming inference (reference test/test.py:25-37) against a
+single-device predict loop (reference test/local_infer.py:16-23) — on
+whatever devices are available: N devices → N pipeline stages.
+
+Prints exactly one JSON line on stdout:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def timed_window(fn, *, min_iters=8, min_s=3.0, max_iters=512):
+    """Warm call, then measure average seconds/iter over a timed window."""
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        fn()
+        n += 1
+        dt = time.perf_counter() - t0
+        if (n >= min_iters and dt >= min_s) or n >= max_iters:
+            return dt / n
+
+
+def main():
+    from defer_tpu import SpmdPipeline, partition, pipeline_mesh
+    from defer_tpu.models import resnet50, resnet_tiny, RESNET50_8STAGE_CUTS
+
+    devices = jax.devices()
+    n = len(devices)
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+    log(f"bench: {n} x {platform} device(s)")
+
+    if on_tpu:
+        graph = resnet50()
+        in_shape = (224, 224, 3)
+        compute_dtype = jnp.bfloat16
+        chunk = 32
+    else:  # CI / local smoke: small model, same code path
+        graph = resnet_tiny()
+        in_shape = (32, 32, 3)
+        compute_dtype = None
+        chunk = 8
+
+    params = graph.init(jax.random.key(0))
+
+    # ---- single-chip baseline (reference test/local_infer.py semantics)
+    fwd = jax.jit(lambda p, x: graph.apply(p, x))
+    if compute_dtype is not None:
+        params_c = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    else:
+        params_c = params
+    x1 = jnp.zeros((1,) + in_shape,
+                   compute_dtype or jnp.float32)
+    y = fwd(params_c, x1)
+    y.block_until_ready()
+    sec = timed_window(lambda: fwd(params_c, x1).block_until_ready())
+    single_ips = 1.0 / sec
+    log(f"single-chip: {single_ips:.2f} img/s ({sec * 1e3:.3f} ms/img)")
+
+    # ---- pipelined inference over all devices (reference test/test.py)
+    num_stages = n
+    if on_tpu and num_stages == 8:
+        cuts = RESNET50_8STAGE_CUTS  # the reference's exact cut list
+        stages = partition(graph, cuts)
+    else:
+        stages = partition(graph, num_stages=num_stages)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(num_stages),
+                        microbatch=1, chunk=chunk,
+                        buffer_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                        compute_dtype=compute_dtype)
+    inputs = np.zeros((chunk, 1) + in_shape, np.float32)
+
+    def run_chunk():
+        outs = pipe.push(inputs)
+        jax.block_until_ready(pipe._a)
+        return outs
+
+    pipe.reset()
+    sec_chunk = timed_window(run_chunk)
+    pipe_ips = chunk / sec_chunk
+    log(f"pipeline ({num_stages} stages): {pipe_ips:.2f} img/s "
+        f"steady-state, buffer {pipe.buf_elems} elems/hop")
+
+    result = {
+        "metric": f"resnet50_{num_stages}stage_pipeline_throughput"
+        if on_tpu else f"resnet_tiny_{num_stages}stage_pipeline_throughput",
+        "value": round(pipe_ips, 3),
+        "unit": "inferences/sec",
+        "vs_baseline": round(pipe_ips / single_ips, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

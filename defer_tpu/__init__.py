@@ -1,0 +1,42 @@
+"""defer_tpu — TPU-native distributed pipelined DNN inference.
+
+A ground-up JAX/XLA re-design of the capabilities of ANRGUSC/DEFER
+(arXiv:2201.06769): partition a model DAG into N sequential stages, place
+stage i on device i of a TPU mesh, and stream inference inputs through the
+chain with every stage concurrently busy.  The reference's TCP relay chain
+becomes a single SPMD program (``shard_map`` + ``lax.ppermute`` over ICI);
+its ZFP/LZ4 wire codec becomes bfloat16 HBM-resident buffers.
+
+Quick start::
+
+    import defer_tpu as dt
+
+    graph = dt.models.resnet50()
+    params = graph.init(jax.random.key(0))
+    defer = dt.Defer(config=dt.DeferConfig(microbatch=1, chunk=16))
+    outputs = defer.run(graph, params, inputs, num_stages=8)
+"""
+
+from . import models
+from .graph.analysis import auto_cut_points, total_flops, valid_cut_points
+from .graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
+from .graph.viz import summary, to_dot
+from .parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
+from .partition.partitioner import partition
+from .partition.stage import StageSpec
+from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
+from .runtime.mpmd import MpmdPipeline
+from .runtime.spmd import SpmdPipeline
+from .utils.config import DeferConfig
+from .utils.metrics import PipelineMetrics, StopwatchWindow
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GraphBuilder", "LayerGraph", "Op", "ShapeSpec", "StageSpec",
+    "partition", "valid_cut_points", "auto_cut_points", "total_flops",
+    "summary", "to_dot",
+    "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",
+    "SpmdPipeline", "MpmdPipeline", "Defer", "DeferHandle", "DeferConfig",
+    "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
+]

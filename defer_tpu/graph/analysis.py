@@ -1,0 +1,103 @@
+"""Graph analysis: cut-point discovery, cost modeling, auto-partitioning.
+
+The reference requires partition boundaries to be single-tensor cut points but
+never checks this — it silently relies on the caller cutting ResNet50 only at
+``add_*`` articulation layers (reference test/test.py:18; the single Input at
+src/dag_util.py:28 is the implicit constraint).  Here cut validity is computed
+from the DAG: a node ``v`` is a valid cut iff *every* edge from the prefix
+(nodes up to and including ``v`` in topological order) into the suffix
+originates at ``v`` — i.e. exactly one tensor crosses the boundary.  Invalid
+cuts fail loudly in the partitioner (SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+from .ir import LayerGraph
+
+
+def valid_cut_points(graph: LayerGraph) -> list[str]:
+    """Names of nodes whose output is the *only* tensor crossing the cut.
+
+    Linear scan over the topological order: a cut after position ``i`` is
+    valid iff no node earlier than ``i`` has a consumer later than ``i``.
+    The graph output itself is excluded (cutting there yields an empty
+    stage).
+    """
+    order = graph.topo_order
+    pos = {name: i for i, name in enumerate(order)}
+    pos[graph.input_name] = -1
+
+    # Latest consumer position for every tensor (input + all nodes).
+    last_use = {graph.input_name: -1}
+    for name in order:
+        last_use.setdefault(name, pos[name])
+        for src in graph.nodes[name].inputs:
+            last_use[src] = max(last_use[src], pos[name])
+
+    cuts = []
+    running_max = last_use[graph.input_name]
+    for i, name in enumerate(order):
+        if i > 0:
+            running_max = max(running_max, last_use[order[i - 1]])
+        # Edges from strictly-earlier nodes may not reach past position i.
+        if running_max <= i and name != graph.output_name:
+            cuts.append(name)
+    return cuts
+
+
+def node_flops(graph: LayerGraph, name: str) -> int:
+    node = graph.nodes[name]
+    in_specs = tuple(graph.out_spec(i) for i in node.inputs)
+    return node.op.flops(in_specs, node.out_spec)
+
+
+def total_flops(graph: LayerGraph) -> int:
+    return sum(node_flops(graph, n) for n in graph.topo_order)
+
+
+def auto_cut_points(graph: LayerGraph, num_stages: int) -> list[str]:
+    """Pick ``num_stages - 1`` valid cuts balancing per-stage FLOPs.
+
+    This is the principled version of DEFER's hand-listed
+    ``["add_2", "add_4", ...]`` (reference test/test.py:18): cumulative cost
+    quantiles snapped to the nearest valid articulation point.
+    """
+    if num_stages < 1:
+        raise ValueError("num_stages must be >= 1")
+    if num_stages == 1:
+        return []
+    cuts = valid_cut_points(graph)
+    if len(cuts) < num_stages - 1:
+        raise ValueError(
+            f"graph {graph.name!r} has only {len(cuts)} valid cut points; "
+            f"cannot make {num_stages} stages")
+
+    order = graph.topo_order
+    cum = {}
+    acc = 0
+    for name in order:
+        acc += node_flops(graph, name)
+        cum[name] = acc
+    total = max(acc, 1)
+
+    chosen: list[str] = []
+    available = list(cuts)
+    for j in range(1, num_stages):
+        target = total * j / num_stages
+        # nearest still-available cut by cumulative cost, keeping order
+        best = min(available, key=lambda n: abs(cum[n] - target))
+        chosen.append(best)
+        # drop this cut and everything before it to preserve ordering
+        available = available[available.index(best) + 1:]
+        if not available and j < num_stages - 1:
+            raise ValueError("ran out of cut points while balancing; "
+                             f"got {len(chosen)} of {num_stages - 1}")
+    return chosen
+
+
+def max_activation_elems(graph: LayerGraph, cut_points: list[str]) -> int:
+    """Largest per-sample tensor crossing any stage boundary (incl. graph
+    input/output) — sizes the SPMD pipeline's homogeneous transfer buffer."""
+    sizes = [graph.input_spec.size, graph.output_spec.size]
+    sizes += [graph.out_spec(c).size for c in cut_points]
+    return max(sizes)

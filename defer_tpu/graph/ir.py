@@ -1,0 +1,264 @@
+"""Layer-graph IR: the model representation the partitioner operates on.
+
+The reference framework introspects Keras graphs at runtime
+(``model.get_layer(name).inbound_nodes`` — reference src/dag_util.py:3-7) to
+rebuild sub-models between cut points.  JAX has no such runtime graph, so this
+module *owns* the graph: models are built as an explicit DAG of named layer
+nodes (op + input edges), and every downstream component (partitioner, stage
+compiler, pipeline runtime) consumes this IR.
+
+Design choices vs. the reference:
+  * Graph structure is static and explicit — no runtime re-invocation of layer
+    objects (reference src/dag_util.py:23-24).
+  * Forward evaluation is memoized topological traversal, fixing the
+    exponential re-visit of shared ancestors on branching DAGs
+    (reference src/dag_util.py:16-17 has no memoization).
+  * Parameters are a separate pytree keyed by node name, so the same graph
+    can be initialized, loaded from checkpoint, cast, or sharded without
+    touching structure.  Shapes are stored *batchless*; apply() is batched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # pytree of arrays (or None for parameterless ops)
+
+
+class ShapeSpec:
+    """Batchless shape+dtype of one inter-layer tensor."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape: Sequence[int], dtype: Any = jnp.float32):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(dtype)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def batched(self, batch: int) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct((batch,) + self.shape, self.dtype)
+
+    def __repr__(self):
+        return f"ShapeSpec({self.shape}, {self.dtype.name})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ShapeSpec)
+            and self.shape == other.shape
+            and self.dtype == other.dtype
+        )
+
+
+class Op:
+    """Base class for layer ops.
+
+    Subclasses implement ``init`` (parameter construction from input shapes)
+    and ``apply`` (batched forward).  ``apply`` must be pure and jit-safe.
+    """
+
+    def init(self, key: jax.Array, in_specs: tuple[ShapeSpec, ...]) -> Params:
+        del key, in_specs
+        return None
+
+    def apply(self, params: Params, *xs: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def flops(self, in_specs: tuple[ShapeSpec, ...], out_spec: ShapeSpec) -> int:
+        """Rough per-sample FLOP estimate, used for balanced auto-partition."""
+        del in_specs
+        return out_spec.size  # elementwise default
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNode:
+    name: str
+    op: Op
+    inputs: tuple[str, ...]
+    out_spec: ShapeSpec
+    param_spec: Any  # pytree of jax.ShapeDtypeStruct, or None
+
+
+class LayerGraph:
+    """A single-input single-output DAG of layer nodes in topological order.
+
+    ``nodes`` is an insertion-ordered dict; the builder only appends a node
+    after all of its inputs exist, so iteration order *is* a topological
+    order.  This linearization is what partitioning cuts along (the
+    reference's equivalent is the Keras layer list + ``inbound_nodes``).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        nodes: dict[str, LayerNode],
+        input_name: str,
+        output_name: str,
+        input_spec: ShapeSpec,
+    ):
+        self.name = name
+        self.nodes = nodes
+        self.input_name = input_name
+        self.output_name = output_name
+        self.input_spec = input_spec
+
+    # -- structure ---------------------------------------------------------
+
+    @property
+    def topo_order(self) -> list[str]:
+        return list(self.nodes)
+
+    def predecessors(self, name: str) -> tuple[str, ...]:
+        """DEFER's ``get_previous`` (reference src/dag_util.py:3-7)."""
+        return self.nodes[name].inputs
+
+    def out_spec(self, name: str) -> ShapeSpec:
+        if name == self.input_name:
+            return self.input_spec
+        return self.nodes[name].out_spec
+
+    @property
+    def output_spec(self) -> ShapeSpec:
+        return self.out_spec(self.output_name)
+
+    # -- parameters --------------------------------------------------------
+
+    def init(self, key: jax.Array) -> dict[str, Params]:
+        """Initialize a fresh parameter pytree keyed by node name."""
+        params: dict[str, Params] = {}
+        keys = jax.random.split(key, max(len(self.nodes), 1))
+        for k, node in zip(keys, self.nodes.values()):
+            if node.param_spec is None:
+                continue
+            in_specs = tuple(self.out_spec(i) for i in node.inputs)
+            params[node.name] = node.op.init(k, in_specs)
+        return params
+
+    # -- evaluation --------------------------------------------------------
+
+    def apply(
+        self,
+        params: dict[str, Params],
+        x: jax.Array,
+        *,
+        upto: str | None = None,
+        start: str | None = None,
+        node_names: Sequence[str] | None = None,
+    ) -> jax.Array:
+        """Memoized forward pass over (a sub-range of) the graph.
+
+        ``start``/``upto``/``node_names`` support stage evaluation: with
+        ``start=c`` the cache is seeded with ``{c: x}`` and only
+        ``node_names`` are evaluated.  This is the functional equivalent of
+        the reference's ``construct_model(model, start, end)``
+        (src/dag_util.py:27-31) without rebuilding any graph.
+        """
+        start = start or self.input_name
+        upto = upto or self.output_name
+        cache: dict[str, jax.Array] = {start: x}
+        names = node_names if node_names is not None else self.topo_order
+        for name in names:
+            if name in cache:  # the seeded start node
+                continue
+            node = self.nodes[name]
+            xs = [cache[i] for i in node.inputs]
+            cache[name] = node.op.apply(params.get(name), *xs)
+            if name == upto:
+                break
+        return cache[upto]
+
+    def __repr__(self):
+        return f"LayerGraph({self.name!r}, {len(self.nodes)} nodes)"
+
+
+class GraphBuilder:
+    """Functional-style graph construction (the Keras-functional analogue).
+
+    Shape inference runs eagerly at build time via ``jax.eval_shape`` so no
+    parameters are materialized until ``graph.init(key)``.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._nodes: dict[str, LayerNode] = {}
+        self._input_name: str | None = None
+        self._input_spec: ShapeSpec | None = None
+        self._counts: dict[str, int] = {}
+        self._last: str | None = None
+
+    def input(self, shape: Sequence[int], dtype: Any = jnp.float32) -> str:
+        if self._input_name is not None:
+            raise ValueError("graph already has an input")
+        self._input_name = "input"
+        self._input_spec = ShapeSpec(shape, dtype)
+        self._last = self._input_name
+        return self._input_name
+
+    def _auto_name(self, op: Op) -> str:
+        base = type(op).__name__.lower()
+        n = self._counts.get(base, 0)
+        self._counts[base] = n + 1
+        return f"{base}_{n}" if n else base
+
+    def _spec_of(self, name: str) -> ShapeSpec:
+        if name == self._input_name:
+            assert self._input_spec is not None
+            return self._input_spec
+        return self._nodes[name].out_spec
+
+    def add(
+        self,
+        op: Op,
+        inputs: str | Sequence[str] | None = None,
+        *,
+        name: str | None = None,
+    ) -> str:
+        """Append a node; returns its name (usable as a cut point)."""
+        if self._input_name is None:
+            raise ValueError("call input() first")
+        if inputs is None:
+            inputs = [self._last]
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        inputs = tuple(inputs)
+        for i in inputs:
+            if i != self._input_name and i not in self._nodes:
+                raise ValueError(f"unknown input node {i!r}")
+        name = name or self._auto_name(op)
+        if name in self._nodes or name == self._input_name:
+            raise ValueError(f"duplicate node name {name!r}")
+
+        in_specs = tuple(self._spec_of(i) for i in inputs)
+        param_spec = jax.eval_shape(lambda k: op.init(k, in_specs),
+                                    jax.ShapeDtypeStruct((2,), jnp.uint32))
+        batched = [s.batched(1) for s in in_specs]
+        out = jax.eval_shape(op.apply, param_spec, *batched)
+        if not isinstance(out, jax.ShapeDtypeStruct) or not hasattr(out, "shape"):
+            raise TypeError(f"op {op!r} must return a single array")
+        out_spec = ShapeSpec(out.shape[1:], out.dtype)
+
+        if jax.tree_util.tree_leaves(param_spec) == []:
+            param_spec = None
+        self._nodes[name] = LayerNode(name, op, inputs, out_spec, param_spec)
+        self._last = name
+        return name
+
+    def build(self, output: str | None = None) -> LayerGraph:
+        if self._input_name is None or not self._nodes:
+            raise ValueError("empty graph")
+        output = output or self._last
+        assert self._input_spec is not None
+        return LayerGraph(self.name, dict(self._nodes), self._input_name,
+                          output, self._input_spec)

@@ -1,0 +1,381 @@
+"""Layer op library (NHWC, MXU-friendly).
+
+These replace the Keras layer zoo the reference leans on (its compute is
+entirely ``model.predict`` — reference src/node.py:106).  Conventions:
+
+  * NHWC activations / HWIO kernels — the TPU-native conv layout.
+  * Parameters are created in float32; ``apply`` computes in the incoming
+    activation dtype (cast params down), so running the pipeline in bfloat16
+    keeps the MXU fed without separate model definitions.
+  * BatchNorm is inference-mode (folded running stats), matching DEFER's
+    inference-only scope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ir import Op, ShapeSpec
+
+
+def _cast(p, dtype):
+    return jax.tree.map(lambda a: a.astype(dtype), p)
+
+
+# ---------------------------------------------------------------------------
+# dense / conv
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Dense(Op):
+    features: int
+    use_bias: bool = True
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        wkey, _ = jax.random.split(key)
+        scale = 1.0 / math.sqrt(d)
+        p = {"w": jax.random.uniform(wkey, (d, self.features), jnp.float32,
+                                     -scale, scale)}
+        if self.use_bias:
+            p["b"] = jnp.zeros((self.features,), jnp.float32)
+        return p
+
+    def apply(self, params, x):
+        p = _cast(params, x.dtype)
+        y = x @ p["w"]
+        if self.use_bias:
+            y = y + p["b"]
+        return y
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        return 2 * spec.size * self.features
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Conv2D(Op):
+    features: int
+    kernel: int | tuple[int, int] = 3
+    stride: int | tuple[int, int] = 1
+    padding: str = "SAME"  # or "VALID"
+    use_bias: bool = True
+    groups: int = 1
+
+    def _k(self):
+        k = self.kernel
+        return (k, k) if isinstance(k, int) else tuple(k)
+
+    def _s(self):
+        s = self.stride
+        return (s, s) if isinstance(s, int) else tuple(s)
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        kh, kw = self._k()
+        cin = spec.shape[-1]
+        fan_in = kh * kw * cin // self.groups
+        wkey, _ = jax.random.split(key)
+        p = {"w": jax.random.normal(wkey, (kh, kw, cin // self.groups,
+                                           self.features), jnp.float32)
+             * math.sqrt(2.0 / fan_in)}
+        if self.use_bias:
+            p["b"] = jnp.zeros((self.features,), jnp.float32)
+        return p
+
+    def apply(self, params, x):
+        p = _cast(params, x.dtype)
+        y = lax.conv_general_dilated(
+            x, p["w"], window_strides=self._s(), padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        )
+        if self.use_bias:
+            y = y + p["b"]
+        return y
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        kh, kw = self._k()
+        cin = spec.shape[-1]
+        return 2 * out_spec.size * kh * kw * cin // self.groups
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class DepthwiseConv2D(Op):
+    kernel: int = 3
+    stride: int = 1
+    padding: str = "SAME"
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        c = spec.shape[-1]
+        k = self.kernel
+        return {"w": jax.random.normal(key, (k, k, 1, c), jnp.float32)
+                * math.sqrt(2.0 / (k * k))}
+
+    def apply(self, params, x):
+        p = _cast(params, x.dtype)
+        c = x.shape[-1]
+        return lax.conv_general_dilated(
+            x, p["w"], window_strides=(self.stride, self.stride),
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+        )
+
+    def flops(self, in_specs, out_spec):
+        return 2 * out_spec.size * self.kernel * self.kernel
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class BatchNorm(Op):
+    """Inference-mode batch norm (running statistics folded at apply)."""
+
+    eps: float = 1e-5
+
+    def init(self, key, in_specs):
+        del key
+        (spec,) = in_specs
+        c = spec.shape[-1]
+        return {
+            "scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32),
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+
+    def apply(self, params, x):
+        p = _cast(params, x.dtype)
+        inv = lax.rsqrt(p["var"] + jnp.asarray(self.eps, x.dtype))
+        return (x - p["mean"]) * (inv * p["scale"]) + p["bias"]
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class LayerNorm(Op):
+    eps: float = 1e-6
+
+    def init(self, key, in_specs):
+        del key
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        return {"scale": jnp.ones((d,), jnp.float32),
+                "bias": jnp.zeros((d,), jnp.float32)}
+
+    def apply(self, params, x):
+        p = _cast(params, x.dtype)
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + jnp.asarray(self.eps, x.dtype)) \
+            * p["scale"] + p["bias"]
+
+
+# ---------------------------------------------------------------------------
+# activations / pooling / structural
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Activation(Op):
+    kind: str = "relu"  # relu | relu6 | gelu | swish | softmax | tanh
+
+    def apply(self, params, x):
+        del params
+        if self.kind == "relu":
+            return jax.nn.relu(x)
+        if self.kind == "relu6":
+            return jnp.minimum(jax.nn.relu(x), jnp.asarray(6, x.dtype))
+        if self.kind == "gelu":
+            return jax.nn.gelu(x)
+        if self.kind == "swish":
+            return jax.nn.swish(x)
+        if self.kind == "softmax":
+            return jax.nn.softmax(x, axis=-1)
+        if self.kind == "tanh":
+            return jnp.tanh(x)
+        raise ValueError(self.kind)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MaxPool(Op):
+    window: int = 2
+    stride: int | None = None
+    padding: str = "VALID"
+
+    def apply(self, params, x):
+        del params
+        s = self.stride or self.window
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            identity = -jnp.inf
+        else:
+            identity = jnp.iinfo(x.dtype).min
+        return lax.reduce_window(
+            x, identity, lax.max,
+            (1, self.window, self.window, 1), (1, s, s, 1), self.padding)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class AvgPool(Op):
+    window: int = 2
+    stride: int | None = None
+    padding: str = "VALID"
+
+    def apply(self, params, x):
+        del params
+        s = self.stride or self.window
+        one = jnp.asarray(1.0, x.dtype)
+        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                                   (1, self.window, self.window, 1),
+                                   (1, s, s, 1), self.padding)
+        counts = lax.reduce_window(jnp.broadcast_to(one, x.shape),
+                                   jnp.asarray(0, x.dtype), lax.add,
+                                   (1, self.window, self.window, 1),
+                                   (1, s, s, 1), self.padding)
+        return summed / counts
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class GlobalAvgPool(Op):
+    def apply(self, params, x):
+        del params
+        return jnp.mean(x, axis=(1, 2))
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class ZeroPad2D(Op):
+    pad: int = 1
+
+    def apply(self, params, x):
+        del params
+        p = self.pad
+        return jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Add(Op):
+    """Residual merge — DEFER's canonical cut-point layer (its ResNet50
+    benchmark cuts only at ``add_*`` layers, reference test/test.py:18)."""
+
+    def apply(self, params, *xs):
+        del params
+        y = xs[0]
+        for x in xs[1:]:
+            y = y + x
+        return y
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Concat(Op):
+    axis: int = -1
+
+    def apply(self, params, *xs):
+        del params
+        return jnp.concatenate(xs, axis=self.axis)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Flatten(Op):
+    def apply(self, params, x):
+        del params
+        return x.reshape(x.shape[0], -1)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Embedding(Op):
+    vocab: int
+    features: int
+
+    def init(self, key, in_specs):
+        del in_specs
+        return {"table": jax.random.normal(key, (self.vocab, self.features),
+                                           jnp.float32) * 0.02}
+
+    def apply(self, params, x):
+        return params["table"].astype(jnp.float32)[x.astype(jnp.int32)]
+
+
+# ---------------------------------------------------------------------------
+# transformer block (one node per block ⇒ natural BERT cut points)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class TransformerBlock(Op):
+    """Pre-LN transformer encoder block as a single graph node.
+
+    Modeling each block as one node mirrors how the BERT-Base/12 baseline
+    config places one block per pipeline stage (BASELINE.md config 5); every
+    block output is automatically a valid single-tensor cut point.
+    """
+
+    num_heads: int
+    mlp_ratio: int = 4
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        h = self.mlp_ratio * d
+        ks = jax.random.split(key, 6)
+        s = 1.0 / math.sqrt(d)
+        return {
+            "ln1": {"scale": jnp.ones((d,), jnp.float32),
+                    "bias": jnp.zeros((d,), jnp.float32)},
+            "qkv": {"w": jax.random.normal(ks[0], (d, 3 * d), jnp.float32) * s,
+                    "b": jnp.zeros((3 * d,), jnp.float32)},
+            "proj": {"w": jax.random.normal(ks[1], (d, d), jnp.float32) * s,
+                     "b": jnp.zeros((d,), jnp.float32)},
+            "ln2": {"scale": jnp.ones((d,), jnp.float32),
+                    "bias": jnp.zeros((d,), jnp.float32)},
+            "fc1": {"w": jax.random.normal(ks[2], (d, h), jnp.float32) * s,
+                    "b": jnp.zeros((h,), jnp.float32)},
+            "fc2": {"w": jax.random.normal(ks[3], (h, d), jnp.float32)
+                    * (1.0 / math.sqrt(h)),
+                    "b": jnp.zeros((d,), jnp.float32)},
+        }
+
+    @staticmethod
+    def _ln(p, x, eps=1e-6):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + jnp.asarray(eps, x.dtype)) \
+            * p["scale"] + p["bias"]
+
+    def apply(self, params, x):
+        p = _cast(params, x.dtype)
+        b, t, d = x.shape
+        nh = self.num_heads
+        hd = d // nh
+
+        y = self._ln(p["ln1"], x)
+        qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+        att = jax.nn.softmax(att, axis=-1)
+        y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        y = y.transpose(0, 2, 1, 3).reshape(b, t, d)
+        x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
+
+        y = self._ln(p["ln2"], x)
+        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
+        return x + (y @ p["fc2"]["w"] + p["fc2"]["b"])
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        t, d = spec.shape
+        return 2 * t * d * (4 * d + 2 * self.mlp_ratio * d) + 4 * t * t * d

@@ -1,0 +1,5 @@
+"""Model zoo covering the five BASELINE.md benchmark configs."""
+
+from .resnet import (RESNET50_8STAGE_CUTS, resnet, resnet50, resnet_tiny)
+
+__all__ = ["resnet", "resnet50", "resnet_tiny", "RESNET50_8STAGE_CUTS"]

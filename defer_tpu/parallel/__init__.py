@@ -1,0 +1,1 @@
+from .mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh, stage_axis_size

@@ -1,0 +1,42 @@
+"""Device-mesh construction for the pipeline.
+
+The reference's topology is a runtime-configured linear chain of TCP hosts —
+the dispatcher tells each node its successor's IP (reference
+src/dispatcher.py:51-55, src/node.py:29,100).  TPU-natively the topology is a
+static ``jax.sharding.Mesh``: the "stage" axis is the pipeline chain (the
+successor relation is the ``ppermute`` permutation over ICI), and an optional
+"data" axis replicates the whole pipeline for batch parallelism.  Multi-host
+slices get DCN routing automatically from JAX's global mesh machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+STAGE_AXIS = "stage"
+DATA_AXIS = "data"
+
+
+def pipeline_mesh(num_stages: int, data_parallel: int = 1,
+                  devices=None) -> Mesh:
+    """Mesh of shape (data_parallel, num_stages) over the available devices.
+
+    Stage neighbors are placed adjacently so the stage-axis ``ppermute``
+    rides nearest-neighbor ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_stages * data_parallel
+    if len(devices) < need:
+        raise ValueError(
+            f"pipeline needs {need} devices "
+            f"({data_parallel} data x {num_stages} stages) but only "
+            f"{len(devices)} available")
+    arr = np.array(devices[:need]).reshape(data_parallel, num_stages)
+    return Mesh(arr, (DATA_AXIS, STAGE_AXIS))
+
+
+def stage_axis_size(mesh: Mesh) -> int:
+    return mesh.shape[STAGE_AXIS]

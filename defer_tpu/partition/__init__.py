@@ -1,0 +1,2 @@
+from .partitioner import partition
+from .stage import StageSpec

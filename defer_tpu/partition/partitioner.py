@@ -1,0 +1,65 @@
+"""Model partitioner: layer graph + cut points → ordered StageSpecs.
+
+Equivalent capability to the reference's ``DEFER._partition``
+(src/dispatcher.py:27-42), which loops over split-layer names building one
+Keras sub-model per segment.  Differences by design:
+
+  * Cut validity is *checked* against articulation analysis instead of being
+    a silent caller obligation (reference src/dag_util.py:28 requires the cut
+    to be a single tensor but never verifies it).
+  * Partitioning is O(V+E) metadata slicing — no graph reconstruction, no
+    layer re-invocation (reference re-invokes every layer per partition,
+    src/dag_util.py:23-24).
+"""
+
+from __future__ import annotations
+
+from ..graph.analysis import auto_cut_points, valid_cut_points
+from ..graph.ir import LayerGraph
+from .stage import StageSpec
+
+
+def partition(graph: LayerGraph, cut_points: list[str] | None = None,
+              *, num_stages: int | None = None) -> list[StageSpec]:
+    """Split ``graph`` into ``len(cut_points)+1`` sequential stages.
+
+    Either pass explicit ``cut_points`` (node names, in topological order —
+    the analogue of ``partition_layers`` in reference src/dispatcher.py:107)
+    or ``num_stages`` for FLOP-balanced automatic cuts.
+    """
+    if cut_points is None:
+        if num_stages is None:
+            raise ValueError("pass cut_points or num_stages")
+        cut_points = auto_cut_points(graph, num_stages)
+
+    order = graph.topo_order
+    pos = {n: i for i, n in enumerate(order)}
+    valid = set(valid_cut_points(graph))
+    for c in cut_points:
+        if c not in graph.nodes:
+            raise ValueError(f"cut point {c!r} is not a node of {graph.name!r}")
+        if c not in valid:
+            raise ValueError(
+                f"cut point {c!r} is not a single-tensor cut: more than one "
+                f"tensor crosses the boundary (valid cuts: {sorted(valid)})")
+    if any(pos[a] >= pos[b] for a, b in zip(cut_points, cut_points[1:])):
+        raise ValueError("cut_points must be in topological order and unique")
+
+    bounds = [graph.input_name] + list(cut_points) + [graph.output_name]
+    stages = []
+    for s in range(len(cut_points) + 1):
+        start, end = bounds[s], bounds[s + 1]
+        lo = pos[start] + 1 if start != graph.input_name else 0
+        hi = pos[end] + 1
+        names = tuple(order[lo:hi])
+        stages.append(StageSpec(
+            index=s,
+            name=f"{graph.name}/stage{s}",
+            graph=graph,
+            node_names=names,
+            input_name=start,
+            output_name=end,
+            in_spec=graph.out_spec(start),
+            out_spec=graph.out_spec(end),
+        ))
+    return stages

@@ -1,0 +1,45 @@
+"""StageSpec: one pipeline stage = a contiguous slice of the layer graph.
+
+The TPU-native replacement for the reference's per-partition
+``tf.keras.Model`` (built by ``construct_model``, reference
+src/dag_util.py:27-31, and shipped over TCP as JSON+weights, reference
+src/dispatcher.py:44-65).  A StageSpec is pure metadata + a pure function;
+nothing is serialized or shipped — placement happens via sharding at
+compile time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+
+from ..graph.ir import LayerGraph, ShapeSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    index: int
+    name: str
+    graph: LayerGraph
+    node_names: tuple[str, ...]   # topo-ordered nodes evaluated by this stage
+    input_name: str               # upstream node (or graph input) feeding it
+    output_name: str
+    in_spec: ShapeSpec
+    out_spec: ShapeSpec
+
+    def fn(self, stage_params: dict[str, Any], x: jax.Array) -> jax.Array:
+        """Pure batched forward for this stage."""
+        return self.graph.apply(stage_params, x, start=self.input_name,
+                                upto=self.output_name,
+                                node_names=self.node_names)
+
+    def select_params(self, params: dict[str, Any]) -> dict[str, Any]:
+        """Subset of the full parameter pytree owned by this stage."""
+        return {n: params[n] for n in self.node_names if n in params}
+
+    def __repr__(self):
+        return (f"StageSpec({self.index}: {self.input_name} -> "
+                f"{self.output_name}, {len(self.node_names)} nodes, "
+                f"in={self.in_spec.shape}, out={self.out_spec.shape})")

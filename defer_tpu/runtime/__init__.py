@@ -1,0 +1,3 @@
+from .dispatcher import Defer, DeferConfig, DeferHandle, END_OF_STREAM
+from .mpmd import MpmdPipeline
+from .spmd import SpmdPipeline

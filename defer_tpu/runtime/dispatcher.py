@@ -1,0 +1,190 @@
+"""Dispatcher: the user-facing API (capability parity with the reference).
+
+The reference's single entry point is
+``DEFER(computeNodes).run_defer(model, partition_layers, input_stream,
+output_stream)`` (src/dispatcher.py:107-115): it partitions, ships
+sub-models to TCP nodes, then streams a queue of inputs through the chain
+and surfaces results on an output queue.  The TPU-native ``Defer`` keeps the
+same shape — queue in, queue out, streaming forever until told to stop — but
+placement is a device mesh instead of IPs, and all data movement is
+ICI/HBM-side (zero CPU-side tensor serialization, per BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..graph.ir import LayerGraph
+from ..parallel.mesh import pipeline_mesh
+from ..partition.partitioner import partition
+from ..utils.config import DeferConfig
+from .mpmd import MpmdPipeline
+from .spmd import SpmdPipeline
+
+#: sentinel a producer puts on the input queue to end the stream
+END_OF_STREAM = None
+
+
+class DeferHandle:
+    """Handle to a running streaming deployment (returned by ``run_defer``)."""
+
+    def __init__(self, thread: threading.Thread, pipeline,
+                 stop_event: threading.Event):
+        self._thread = thread
+        self.pipeline = pipeline
+        self._stop = stop_event
+        #: exception that killed the serve thread, if any
+        self.error: BaseException | None = None
+
+    def stop(self):
+        self._stop.set()
+
+    def join(self, timeout: float | None = None):
+        """Wait for the serve thread; re-raises any error it died with."""
+        self._thread.join(timeout)
+        if self.error is not None:
+            raise RuntimeError("defer dispatcher thread failed") from self.error
+
+    @property
+    def metrics(self):
+        return self.pipeline.metrics
+
+
+class Defer:
+    """TPU-native DEFER deployment.
+
+    ``mesh`` plays the role of the reference's ``computeNodes`` IP list
+    (src/dispatcher.py:21): it names the devices that will host pipeline
+    stages.
+    """
+
+    def __init__(self, mesh=None, config: DeferConfig | None = None):
+        self.mesh = mesh
+        self.config = config or DeferConfig()
+
+    # -- construction ------------------------------------------------------
+
+    def build(self, graph: LayerGraph, params: dict[str, Any],
+              cut_points: list[str] | None = None,
+              num_stages: int | None = None):
+        """Partition + compile; returns the pipeline engine."""
+        cfg = self.config
+        stages = partition(graph, cut_points, num_stages=num_stages)
+        if cfg.mode == "mpmd":
+            devices = None
+            if self.mesh is not None:
+                devices = list(self.mesh.devices.flatten())
+            return MpmdPipeline(stages, params, devices=devices,
+                                microbatch=cfg.microbatch,
+                                compute_dtype=cfg.compute_dtype)
+        mesh = self.mesh
+        if mesh is None:
+            mesh = pipeline_mesh(len(stages), cfg.data_parallel)
+        return SpmdPipeline(
+            stages, params, mesh=mesh,
+            microbatch=cfg.microbatch, chunk=cfg.chunk,
+            buffer_dtype=jnp.dtype(cfg.buffer_dtype),
+            compute_dtype=cfg.compute_dtype,
+        )
+
+    # -- batch API ---------------------------------------------------------
+
+    def run(self, graph, params, inputs, cut_points=None, num_stages=None):
+        """One-shot batched inference over the pipeline."""
+        pipe = self.build(graph, params, cut_points, num_stages)
+        return pipe.run(inputs)
+
+    # -- streaming APIs ----------------------------------------------------
+
+    def stream(self, graph, params, inputs: Iterable[np.ndarray],
+               cut_points=None, num_stages=None) -> Iterator[np.ndarray]:
+        """Generator streaming: yields one output per input microbatch."""
+        pipe = self.build(graph, params, cut_points, num_stages)
+        if isinstance(pipe, MpmdPipeline):
+            for x in inputs:
+                yield pipe.run(x[None])[0]
+            return
+        pipe.reset()
+        batch: list[np.ndarray] = []
+        for x in inputs:
+            batch.append(x)
+            if len(batch) == pipe.chunk:
+                yield from pipe.push(np.stack(batch))
+                batch.clear()
+        if batch:
+            pad = [np.zeros_like(batch[0])] * (pipe.chunk - len(batch))
+            yield from pipe.push(np.stack(batch + pad), n_real=len(batch))
+        yield from pipe.flush()
+
+    def run_defer(self, graph, params, cut_points,
+                  input_stream: queue.Queue, output_stream: queue.Queue,
+                  *, num_stages=None) -> DeferHandle:
+        """Queue-in/queue-out streaming service (the reference's entry point,
+        src/dispatcher.py:107).  Returns immediately with a handle; a daemon
+        thread drains ``input_stream`` and fills ``output_stream``.  Put
+        ``END_OF_STREAM`` (None) on the input queue — or call
+        ``handle.stop()`` — to shut down after draining the pipe.
+        """
+        pipe = self.build(graph, params, cut_points, num_stages)
+        stop = threading.Event()
+        cfg = self.config
+
+        def serve():
+            try:
+                _serve_inner()
+            except BaseException as e:  # surface errors instead of a silent
+                handle.error = e        # dead thread + forever-blocked reader
+                output_stream.put(END_OF_STREAM)
+
+        def _serve_inner():
+            if isinstance(pipe, MpmdPipeline):
+                while not stop.is_set():
+                    try:
+                        x = input_stream.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    if x is END_OF_STREAM:
+                        break
+                    output_stream.put(pipe.run(np.asarray(x)[None])[0])
+                return
+
+            pipe.reset()
+            done = False
+            while not done and not stop.is_set():
+                batch: list[np.ndarray] = []
+                try:
+                    batch.append(input_stream.get(timeout=0.05))
+                except queue.Empty:
+                    continue
+                if batch[0] is END_OF_STREAM:
+                    break
+                # opportunistically gather a fuller chunk (the reference's
+                # in-flight window); don't stall waiting for stragglers
+                while len(batch) < pipe.chunk:
+                    try:
+                        nxt = input_stream.get(timeout=cfg.gather_timeout_s)
+                    except queue.Empty:
+                        break
+                    if nxt is END_OF_STREAM:
+                        done = True
+                        break
+                    batch.append(nxt)
+                n_real = len(batch)
+                pad = [np.zeros_like(batch[0])] * (pipe.chunk - n_real)
+                outs = pipe.push(np.stack(batch + pad), n_real=n_real)
+                for o in outs:
+                    output_stream.put(np.asarray(o, np.float32))
+            for o in pipe.flush():
+                output_stream.put(np.asarray(o, np.float32))
+
+        thread = threading.Thread(target=serve, daemon=True,
+                                  name="defer-dispatcher")
+        handle = DeferHandle(thread, pipe, stop)
+        thread.start()
+        return handle

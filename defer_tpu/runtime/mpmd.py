@@ -1,0 +1,82 @@
+"""MPMD relay pipeline — the correctness oracle / debug execution mode.
+
+This is the execution model closest to the reference's architecture: one
+compiled program per stage, each pinned to its own device, with activations
+relayed stage→stage (reference: per-node ``model.predict`` + socket relay,
+src/node.py:103-108).  Here the relay is ``jax.device_put`` between devices
+(host-mediated or direct device-to-device; no sockets, no serialization) and
+pipelining across in-flight microbatches falls out of JAX's async dispatch —
+the host issues work for many microbatches ahead of completion, which is the
+analogue of the reference's bounded in-flight queue (src/node.py:114).
+
+Use it to cross-check the SPMD engine (identical outputs required) and for
+wildly heterogeneous stage shapes where the homogeneous SPMD buffer would be
+wasteful (SURVEY.md §7 model B).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..partition.stage import StageSpec
+from ..utils.metrics import PipelineMetrics
+import time
+
+
+class MpmdPipeline:
+    def __init__(self, stages: Sequence[StageSpec], params: dict[str, Any],
+                 *, devices=None, microbatch: int = 1, compute_dtype=None):
+        self.stages = list(stages)
+        self.num_stages = n = len(self.stages)
+        self.microbatch = microbatch
+        devices = list(devices if devices is not None else jax.devices())
+        # round-robin placement if fewer devices than stages (single-chip
+        # debugging still works: every stage on the one device)
+        self.devices = [devices[i % len(devices)] for i in range(n)]
+        self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+
+        self._fns = [jax.jit(s.fn) for s in self.stages]
+        self._params = [
+            jax.device_put(s.select_params(params), d)
+            for s, d in zip(self.stages, self.devices)
+        ]
+        self.in_spec = self.stages[0].in_spec
+        self.out_spec = self.stages[-1].out_spec
+        self.metrics = PipelineMetrics(num_stages=n)
+
+    def run(self, inputs: np.ndarray) -> np.ndarray:
+        """[M, microbatch, *in_shape] -> [M, microbatch, *out_shape].
+
+        All M microbatches are issued without blocking; async dispatch keeps
+        every stage device busy on a different in-flight microbatch.
+        """
+        inputs = np.asarray(inputs)
+        m = inputs.shape[0]
+        t0 = time.perf_counter()
+        outs = []
+        for i in range(m):
+            x = jnp.asarray(inputs[i], self.in_spec.dtype)
+            if self.compute_dtype is not None and jnp.issubdtype(
+                    self.in_spec.dtype, jnp.floating):
+                x = x.astype(self.compute_dtype)
+            x = jax.device_put(x, self.devices[0])
+            for k in range(self.num_stages):
+                y = self._fns[k](self._params[k], x)
+                if k + 1 < self.num_stages \
+                        and self.devices[k + 1] != self.devices[k]:
+                    y = jax.device_put(y, self.devices[k + 1])
+                x = y
+            outs.append(x)
+        result = np.stack([np.asarray(jax.device_get(o), np.float32)
+                           for o in outs])
+        self.metrics.wall_s += time.perf_counter() - t0
+        self.metrics.inferences += m * self.microbatch
+        return result
+
+    def __call__(self, inputs: np.ndarray) -> np.ndarray:
+        return self.run(inputs)

@@ -1,0 +1,2 @@
+from .config import DeferConfig
+from .metrics import PipelineMetrics, StopwatchWindow

@@ -1,0 +1,35 @@
+"""Typed configuration for the pipeline runtime.
+
+Replaces the reference's scattered hardcoded constants — ports 5000/5001/5002
+(src/dispatcher.py:18, src/node.py:17), 512 KB chunk size
+(src/dispatcher.py:24, src/node.py:111), Queue(1000) in-flight bound
+(src/node.py:114), 5 s poll loops (src/node.py:33,96) — with one dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class DeferConfig:
+    # samples per microbatch (the reference streams 1 image per message,
+    # test/test.py:22 — microbatch=1 is the parity setting)
+    microbatch: int = 1
+    # pipeline steps fused into one jit-compiled scan call; the analogue of
+    # the reference's in-flight window (Queue(1000), src/node.py:114)
+    chunk: int = 16
+    # dtype of the homogeneous inter-stage transfer buffer.  bfloat16 halves
+    # ICI bytes — the TPU-idiomatic analogue of the reference's lossy ZFP
+    # activation compression (src/node.py:107)
+    buffer_dtype: str = "float32"
+    # dtype activations are cast to inside each stage (None = model dtype)
+    compute_dtype: str | None = None
+    # extra batch-parallel pipeline replicas (mesh "data" axis)
+    data_parallel: int = 1
+    # "spmd" (shard_map + ppermute, primary) or "mpmd" (per-stage programs +
+    # device_put relay, correctness oracle / debug)
+    mode: str = "spmd"
+    # seconds the dispatcher waits for more queue items before padding a
+    # partial chunk with bubbles
+    gather_timeout_s: float = 0.002

@@ -1,0 +1,74 @@
+"""Pipeline metrics — first-class per BASELINE.md (inferences/sec and
+per-stage latency).  The reference only counts results in a timed window in
+its harness (test/test.py:29-37); here the runtime itself records stats."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class PipelineMetrics:
+    num_stages: int = 0
+    inferences: int = 0
+    microbatch: int = 1
+    steps: int = 0  # pipeline steps executed (each = one step on every stage)
+    wall_s: float = 0.0
+    chunk_calls: int = 0
+    stage_latency_s: list[float] = dataclasses.field(default_factory=list)
+    buffer_elems: int = 0
+    buffer_bytes_per_hop: int = 0
+
+    @property
+    def throughput(self) -> float:
+        return self.inferences / self.wall_s if self.wall_s > 0 else 0.0
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Fraction of pipeline steps spent on fill/drain bubbles.
+
+        Each emitted microbatch represents one fully-useful pipeline step
+        (every stage worked on a real microbatch exactly once for it).
+        """
+        if self.steps == 0:
+            return 0.0
+        useful_steps = self.inferences / max(self.microbatch, 1)
+        return max(0.0, 1.0 - useful_steps / self.steps)
+
+    def as_dict(self) -> dict:
+        return {
+            "num_stages": self.num_stages,
+            "inferences": self.inferences,
+            "wall_s": round(self.wall_s, 6),
+            "throughput_per_s": round(self.throughput, 3),
+            "chunk_calls": self.chunk_calls,
+            "stage_latency_ms": [round(s * 1e3, 4) for s in self.stage_latency_s],
+            "buffer_bytes_per_hop": self.buffer_bytes_per_hop,
+            "bubble_fraction": round(self.bubble_fraction, 4),
+        }
+
+
+class StopwatchWindow:
+    """Timed-window throughput counter reproducing the reference harness
+    semantics (results drained in a window ÷ window seconds,
+    test/test.py:25-37)."""
+
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        self.count = 0
+        self._t0 = time.perf_counter()
+
+    def tick(self, n: int = 1) -> bool:
+        """Record n results; returns False once the window has elapsed."""
+        self.count += n
+        return (time.perf_counter() - self._t0) < self.window_s
+
+    @property
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+    @property
+    def rate(self) -> float:
+        e = self.elapsed
+        return self.count / e if e > 0 else 0.0

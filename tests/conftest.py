@@ -1,0 +1,29 @@
+"""Test configuration: 8 virtual CPU devices (the idiomatic JAX fake backend
+for multi-device tests — SURVEY.md §4).
+
+Note: this environment pre-registers a TPU PJRT plugin via sitecustomize
+before pytest starts, so env vars alone are too late; we also force platform
+selection through jax.config.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    assert len(jax.devices()) == 8, jax.devices()
+    yield

@@ -1,0 +1,96 @@
+"""Dispatcher API tests: queue-in/queue-out streaming service (capability
+parity with reference run_defer, src/dispatcher.py:107) and generator
+streaming."""
+
+import queue
+
+import jax
+import numpy as np
+
+from defer_tpu import Defer, DeferConfig, END_OF_STREAM
+from defer_tpu.models import resnet_tiny
+
+
+def _ref(g, params, xs):
+    fn = jax.jit(g.apply)
+    return np.stack([np.asarray(fn(params, x), np.float32) for x in xs])
+
+
+def test_run_batch_api():
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=4))
+    inputs = np.asarray(jax.random.normal(jax.random.key(1), (5, 1, 32, 32, 3)))
+    out = defer.run(g, params, inputs, num_stages=4)
+    np.testing.assert_allclose(out, _ref(g, params, inputs),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_stream_generator():
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=4))
+    inputs = np.asarray(jax.random.normal(jax.random.key(2), (6, 1, 32, 32, 3)))
+    outs = list(defer.stream(g, params, iter(inputs), num_stages=2))
+    assert len(outs) == 6
+    got = np.stack([np.asarray(o, np.float32) for o in outs])
+    np.testing.assert_allclose(got, _ref(g, params, inputs),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_run_defer_queue_service():
+    """The reference harness pattern (test/test.py:39-51): spawn run_defer,
+    feed an input queue, drain an output queue."""
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=4))
+    in_q, out_q = queue.Queue(maxsize=10), queue.Queue()
+    handle = defer.run_defer(g, params, ["add_1"], in_q, out_q)
+
+    inputs = np.asarray(jax.random.normal(jax.random.key(3), (7, 1, 32, 32, 3)))
+    for x in inputs:
+        in_q.put(x)
+    in_q.put(END_OF_STREAM)
+    handle.join(timeout=120)
+
+    outs = []
+    while not out_q.empty():
+        outs.append(out_q.get())
+    assert len(outs) == 7
+    got = np.stack(outs)
+    np.testing.assert_allclose(got, _ref(g, params, inputs),
+                               rtol=2e-4, atol=2e-4)
+    assert handle.metrics.inferences == 7
+
+
+def test_run_defer_mpmd_mode():
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(mode="mpmd"))
+    in_q, out_q = queue.Queue(), queue.Queue()
+    handle = defer.run_defer(g, params, ["add_1"], in_q, out_q)
+    inputs = np.asarray(jax.random.normal(jax.random.key(4), (3, 1, 32, 32, 3)))
+    for x in inputs:
+        in_q.put(x)
+    in_q.put(END_OF_STREAM)
+    handle.join(timeout=120)
+    outs = [out_q.get_nowait() for _ in range(3)]
+    got = np.stack(outs)
+    np.testing.assert_allclose(got, _ref(g, params, inputs),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_run_defer_error_propagates():
+    """A bad input must not silently kill the serve thread (consumers would
+    block forever); join() re-raises and the output queue gets a sentinel."""
+    import pytest
+
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2))
+    in_q, out_q = queue.Queue(), queue.Queue()
+    handle = defer.run_defer(g, params, ["add_1"], in_q, out_q)
+    in_q.put(np.zeros((1, 8, 8, 3), np.float32))  # wrong spatial shape
+    with pytest.raises(RuntimeError, match="dispatcher thread failed"):
+        handle.join(timeout=120)
+    assert out_q.get(timeout=10) is END_OF_STREAM

@@ -1,0 +1,105 @@
+"""Graph IR + analysis unit tests (partitioner test strategy per SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu import GraphBuilder, valid_cut_points, auto_cut_points
+from defer_tpu.graph import ops, node_flops, total_flops, max_activation_elems
+from defer_tpu.graph.viz import summary, to_dot
+
+
+def diamond_graph():
+    """input -> a -> (b1, b2) -> add -> d : only a, add, d are valid cuts."""
+    b = GraphBuilder("diamond")
+    x = b.input((4,))
+    a = b.add(ops.Dense(8), x, name="a")
+    b1 = b.add(ops.Dense(8), a, name="b1")
+    b2 = b.add(ops.Dense(8), a, name="b2")
+    m = b.add(ops.Add(), [b1, b2], name="merge")
+    d = b.add(ops.Dense(3), m, name="d")
+    return b.build()
+
+
+def test_builder_shapes():
+    g = diamond_graph()
+    assert g.out_spec("a").shape == (8,)
+    assert g.output_spec.shape == (3,)
+    assert g.topo_order == ["a", "b1", "b2", "merge", "d"]
+    assert g.predecessors("merge") == ("b1", "b2")
+
+
+def test_apply_matches_manual():
+    g = diamond_graph()
+    params = g.init(jax.random.key(0))
+    x = jnp.ones((2, 4))
+    y = g.apply(params, x)
+    a = x @ params["a"]["w"] + params["a"]["b"]
+    b1 = a @ params["b1"]["w"] + params["b1"]["b"]
+    b2 = a @ params["b2"]["w"] + params["b2"]["b"]
+    manual = (b1 + b2) @ params["d"]["w"] + params["d"]["b"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(manual), rtol=1e-6)
+
+
+def test_valid_cut_points_excludes_branch_interior():
+    g = diamond_graph()
+    cuts = valid_cut_points(g)
+    # b1/b2 are inside the diamond: cutting there leaves 2 crossing tensors.
+    assert cuts == ["a", "merge"]  # output node "d" excluded
+
+
+def test_memoization_single_visit():
+    """Shared ancestors are evaluated once (reference dag_util.py re-visits
+    them once per fan-in path — SURVEY.md §3.5)."""
+    calls = {"n": 0}
+
+    class Counting(ops.Dense):
+        def apply(self, params, x):
+            calls["n"] += 1
+            return super().apply(params, x)
+
+    b = GraphBuilder("wide")
+    x = b.input((4,))
+    a = b.add(Counting(4), x, name="a")
+    branches = [b.add(ops.Dense(4), a, name=f"br{i}") for i in range(4)]
+    b.add(ops.Add(), branches, name="m")
+    g = b.build()
+    params = g.init(jax.random.key(0))
+    calls["n"] = 0
+    g.apply(params, jnp.ones((1, 4)))
+    assert calls["n"] == 1
+
+
+def test_auto_cut_points_balanced():
+    b = GraphBuilder("chain")
+    x = b.input((16,))
+    for i in range(10):
+        x = b.add(ops.Dense(16), x, name=f"fc{i}")
+    g = b.build()
+    cuts = auto_cut_points(g, 4)
+    assert len(cuts) == 3
+    order = g.topo_order
+    assert [order.index(c) for c in cuts] == sorted(order.index(c) for c in cuts)
+    with pytest.raises(ValueError):
+        auto_cut_points(g, 50)  # more stages than cut points
+
+
+def test_flops_and_viz():
+    g = diamond_graph()
+    assert node_flops(g, "a") == 2 * 4 * 8
+    assert total_flops(g) > 0
+    assert max_activation_elems(g, ["a"]) >= 8
+    dot = to_dot(g, {"a": 0, "b1": 1})
+    assert "digraph" in dot and '"a"' in dot
+    assert "merge" in summary(g)
+
+
+def test_duplicate_and_unknown_nodes_rejected():
+    b = GraphBuilder("bad")
+    x = b.input((4,))
+    b.add(ops.Dense(4), x, name="a")
+    with pytest.raises(ValueError):
+        b.add(ops.Dense(4), x, name="a")
+    with pytest.raises(ValueError):
+        b.add(ops.Dense(4), "nope")

@@ -1,0 +1,137 @@
+"""SPMD pipeline engine tests on the 8-device CPU mesh.
+
+The load-bearing invariant: pipeline output == single-program output, for
+every stage count, chunking, partial chunks, and data-parallel meshes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu import SpmdPipeline, partition, pipeline_mesh
+from defer_tpu.models import resnet_tiny
+from defer_tpu.graph.analysis import auto_cut_points
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    return g, params
+
+
+def _reference(g, params, inputs):
+    fn = jax.jit(g.apply)
+    return np.stack([np.asarray(fn(params, x), np.float32) for x in inputs])
+
+
+@pytest.mark.parametrize("num_stages", [1, 2, 4, 8])
+def test_pipeline_matches_single_program(tiny, num_stages):
+    g, params = tiny
+    stages = partition(g, num_stages=num_stages)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(num_stages),
+                        microbatch=2, chunk=4)
+    inputs = np.asarray(
+        jax.random.normal(jax.random.key(7), (6, 2, 32, 32, 3)))
+    out = pipe.run(inputs)
+    ref = _reference(g, params, inputs)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_partial_chunks_and_streaming(tiny):
+    g, params = tiny
+    stages = partition(g, num_stages=4)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(4),
+                        microbatch=1, chunk=8)
+    # M=3 < chunk forces bubble padding; M=11 forces a partial tail chunk
+    for m in (3, 11):
+        inputs = np.asarray(
+            jax.random.normal(jax.random.key(m), (m, 1, 32, 32, 3)))
+        out = pipe.run(inputs)
+        ref = _reference(g, params, inputs)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_push_flush_incremental(tiny):
+    g, params = tiny
+    stages = partition(g, num_stages=4)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(4),
+                        microbatch=1, chunk=2)
+    inputs = np.asarray(
+        jax.random.normal(jax.random.key(3), (5, 1, 32, 32, 3)))
+    pipe.reset()
+    outs = []
+    for lo in range(0, 4, 2):
+        outs.extend(pipe.push(inputs[lo:lo + 2]))
+    outs.extend(pipe.push(np.concatenate(
+        [inputs[4:5], np.zeros_like(inputs[:1])]), n_real=1))
+    outs.extend(pipe.flush())
+    assert len(outs) == 5
+    ref = _reference(g, params, inputs)
+    got = np.stack([np.asarray(o, np.float32) for o in outs])
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_data_parallel_mesh(tiny):
+    g, params = tiny
+    stages = partition(g, num_stages=4)
+    mesh = pipeline_mesh(4, data_parallel=2)
+    pipe = SpmdPipeline(stages, params, mesh=mesh, microbatch=4, chunk=4)
+    inputs = np.asarray(
+        jax.random.normal(jax.random.key(9), (5, 4, 32, 32, 3)))
+    out = pipe.run(inputs)
+    ref = _reference(g, params, inputs)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bfloat16_buffer_close(tiny):
+    """bf16 transfer buffer = the TPU analogue of lossy ZFP compression."""
+    g, params = tiny
+    stages = partition(g, num_stages=2)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(2),
+                        microbatch=1, chunk=4, buffer_dtype=jnp.bfloat16)
+    inputs = np.asarray(jax.random.normal(jax.random.key(5), (4, 1, 32, 32, 3)))
+    out = pipe.run(inputs)
+    ref = _reference(g, params, inputs)
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.15)
+
+
+def test_metrics_recorded(tiny):
+    g, params = tiny
+    stages = partition(g, num_stages=4)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(4),
+                        microbatch=1, chunk=4)
+    inputs = np.zeros((8, 1, 32, 32, 3), np.float32)
+    pipe.run(inputs)
+    m = pipe.metrics
+    assert m.inferences == 8
+    assert m.num_stages == 4
+    assert m.wall_s > 0
+    assert m.throughput > 0
+    lats = pipe.stage_latencies(params, iters=2)
+    assert len(lats) == 4 and all(l > 0 for l in lats)
+
+
+def test_int_inputs_require_f32_buffer():
+    """Token-id inputs through a bf16 buffer would silently corrupt ids>256."""
+    from defer_tpu.graph.ir import GraphBuilder
+    from defer_tpu.graph import ops
+
+    b = GraphBuilder("toy_embed")
+    x = b.input((4,), jnp.int32)
+    e = b.add(ops.Embedding(vocab=300, features=8), x, name="embed")
+    b.add(ops.Dense(4), e, name="head")
+    g = b.build()
+    params = g.init(jax.random.key(0))
+    stages = partition(g, ["embed"])
+    with pytest.raises(ValueError, match="float32"):
+        SpmdPipeline(stages, params, mesh=pipeline_mesh(2),
+                     buffer_dtype=jnp.bfloat16)
+    # float32 buffer carries ids exactly
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(2), chunk=2)
+    inputs = np.array([[[1, 299, 5, 257]]], np.int32).repeat(2, axis=0)
+    out = pipe.run(inputs)
+    ref = np.asarray(jax.jit(g.apply)(params, jnp.asarray(inputs[0])))
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-5)
