@@ -21,14 +21,20 @@ from . import models
 from .graph.analysis import auto_cut_points, total_flops, valid_cut_points
 from .graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
 from .graph.viz import summary, to_dot
+from .codec import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
+                    RawCodec)
 from .parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
+from .parallel.ring_attention import (SEQ_AXIS, ring_attention,
+                                      sequence_parallel_attention)
 from .partition.partitioner import partition
 from .partition.stage import StageSpec
 from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
 from .runtime.mpmd import MpmdPipeline
 from .runtime.spmd import SpmdPipeline
+from .utils.checkpoint import load_params, save_params
 from .utils.config import DeferConfig
 from .utils.metrics import PipelineMetrics, StopwatchWindow
+from .utils.profiling import profile_pipeline, trace
 
 __version__ = "0.1.0"
 
@@ -39,4 +45,7 @@ __all__ = [
     "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",
     "SpmdPipeline", "MpmdPipeline", "Defer", "DeferHandle", "DeferConfig",
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
+    "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",
+    "Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec", "RawCodec",
+    "save_params", "load_params", "profile_pipeline", "trace",
 ]

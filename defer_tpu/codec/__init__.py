@@ -1,0 +1,25 @@
+"""Host-side codecs for the host/DCN edge of the pipeline.
+
+Capability parity with the reference's wire codec — ``lz4(zfp(array))`` on
+every payload (reference src/dispatcher.py:81-82, src/node.py:76-77) — built
+TPU-first:
+
+  * On-pod stage→stage transfers use NO codec: activations stay in HBM and
+    ride ICI (SURVEY.md §2.2).  The in-pipeline "compression" analogue is the
+    bfloat16 transfer buffer (``SpmdPipeline(buffer_dtype=bfloat16)``).
+  * The host/DCN edge (streaming ingest/egress, weight shipping to remote
+    hosts) uses first-party native codecs from ``_native/codec.cpp``:
+    ``blockfloat`` (fixed-rate shared-exponent float codec, a ZFP-fixed-rate
+    analogue) + ``lzb`` (LZ77 byte compressor, an LZ4 analogue), composed the
+    same way the reference composes ZFP then LZ4.
+
+The C++ library is compiled on demand with g++; if no toolchain is available
+a pure-NumPy fallback implements the identical formats, so the Python API
+never changes behavior — only speed.
+"""
+
+from .codecs import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
+                     RawCodec, native_available)
+
+__all__ = ["Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec",
+           "RawCodec", "native_available"]

@@ -1,5 +1,25 @@
-"""Model zoo covering the five BASELINE.md benchmark configs."""
+"""Model zoo covering the five BASELINE.md benchmark configs:
 
-from .resnet import (RESNET50_8STAGE_CUTS, resnet, resnet50, resnet_tiny)
+1. ResNet50/8   (reference test/test.py flagship)
+2. VGG19/4      (deep sequential, large activations)
+3. InceptionV3/6 (branching DAG)
+4. MobileNetV2/2 (comm-bound)
+5. BERT-Base/12 (one transformer block per stage)
 
-__all__ = ["resnet", "resnet50", "resnet_tiny", "RESNET50_8STAGE_CUTS"]
+Each family ships a ``*_tiny`` variant for fast CPU-mesh tests.
+"""
+
+from .bert import BERT_BASE_12STAGE_CUTS, bert, bert_base, bert_tiny
+from .inception import (INCEPTION_6STAGE_CUTS, inception, inception_tiny,
+                        inception_v3)
+from .mobilenet import (MOBILENETV2_2STAGE_CUTS, mobilenet_tiny, mobilenet_v2)
+from .resnet import RESNET50_8STAGE_CUTS, resnet, resnet50, resnet_tiny
+from .vgg import VGG19_4STAGE_CUTS, vgg, vgg19, vgg_tiny
+
+__all__ = [
+    "resnet", "resnet50", "resnet_tiny", "RESNET50_8STAGE_CUTS",
+    "vgg", "vgg19", "vgg_tiny", "VGG19_4STAGE_CUTS",
+    "inception", "inception_v3", "inception_tiny", "INCEPTION_6STAGE_CUTS",
+    "mobilenet_v2", "mobilenet_tiny", "MOBILENETV2_2STAGE_CUTS",
+    "bert", "bert_base", "bert_tiny", "BERT_BASE_12STAGE_CUTS",
+]

@@ -1,0 +1,98 @@
+"""BERT-Base encoder (BASELINE.md config 5: 12 partitions, one transformer
+block per pipeline stage).
+
+Each encoder block is a single graph node (``ops.TransformerBlock``), so
+``block_k`` nodes are the natural cut points and the 12-stage config is just
+``cut_points=[block_0 .. block_10]``.  Token-id inputs ride the pipeline's
+float32 transfer buffer exactly (ids < 2^24).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
+from ..graph.ops import Dense, LayerNorm, TransformerBlock
+
+
+class BertEmbedding(Op):
+    """Token + learned positional embeddings, followed by layer norm."""
+
+    def __init__(self, vocab: int, features: int, max_len: int):
+        self.vocab = vocab
+        self.features = features
+        self.max_len = max_len
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        k1, k2 = jax.random.split(key)
+        return {
+            "tok": jax.random.normal(k1, (self.vocab, self.features),
+                                     jnp.float32) * 0.02,
+            "pos": jax.random.normal(k2, (self.max_len, self.features),
+                                     jnp.float32) * 0.02,
+            "ln": {"scale": jnp.ones((self.features,), jnp.float32),
+                   "bias": jnp.zeros((self.features,), jnp.float32)},
+        }
+
+    def apply(self, params, ids):
+        t = ids.shape[1]
+        x = params["tok"][ids.astype(jnp.int32)] + params["pos"][:t]
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+        ln = params["ln"]
+        return (x - mu) * jax.lax.rsqrt(var + 1e-6) * ln["scale"] + ln["bias"]
+
+    def flops(self, in_specs, out_spec):
+        return out_spec.size
+
+
+class Pooler(Op):
+    """[CLS] pooling + tanh projection (BERT's pooler head)."""
+
+    def __init__(self, features: int):
+        self.features = features
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        return {"w": jax.random.normal(key, (d, self.features), jnp.float32)
+                / math.sqrt(d),
+                "b": jnp.zeros((self.features,), jnp.float32)}
+
+    def apply(self, params, x):
+        cls = x[:, 0, :]
+        return jnp.tanh(cls @ params["w"].astype(x.dtype)
+                        + params["b"].astype(x.dtype))
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        return 2 * spec.shape[-1] * self.features
+
+
+def bert(num_layers: int, hidden: int, heads: int, seq_len: int,
+         vocab: int = 30522, name: str = "bert") -> LayerGraph:
+    b = GraphBuilder(name)
+    x = b.input((seq_len,), jnp.int32)
+    x = b.add(BertEmbedding(vocab, hidden, seq_len), x, name="embeddings")
+    for i in range(num_layers):
+        x = b.add(TransformerBlock(heads), x, name=f"block_{i}")
+    x = b.add(LayerNorm(), x, name="final_ln")
+    x = b.add(Pooler(hidden), x, name="pooler")
+    return b.build()
+
+
+def bert_base(seq_len: int = 128) -> LayerGraph:
+    return bert(12, 768, 12, seq_len, name="bert_base")
+
+
+def bert_tiny(seq_len: int = 16) -> LayerGraph:
+    return bert(4, 32, 2, seq_len, vocab=100, name="bert_tiny")
+
+
+#: one encoder block per stage (BASELINE.md config 5): 12 stages — stage 0
+#: holds embeddings + block_0, stage 11 holds block_11 + final_ln + pooler
+BERT_BASE_12STAGE_CUTS = [f"block_{i}" for i in range(11)]

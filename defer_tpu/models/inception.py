@@ -1,0 +1,110 @@
+"""Inception-v3-style branching model (BASELINE.md config 3: 6 partitions —
+the branching-DAG stress test for the partitioner).
+
+Inside each inception block, four parallel branches (1x1 / 5x5 / double-3x3 /
+pool-proj) diverge and re-join at a channel Concat — so nothing inside a
+block is a valid cut point and the articulation analysis must only offer the
+block-boundary ``mixed_k`` concat nodes (plus the sequential stem).  This is
+exactly the property the reference silently depends on when it cuts ResNet50
+only at ``add_*`` layers (reference test/test.py:18, src/dag_util.py:28).
+
+The block structure follows the standard Inception-v3 shape (stem, 3x A
+blocks, grid reduction, 4x B blocks, reduction, 2x C blocks); channel counts
+are parameterizable so tests can run a scaled-down variant.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..graph.ir import GraphBuilder, LayerGraph
+from ..graph.ops import (Activation, AvgPool, BatchNorm, Concat, Conv2D,
+                         Dense, GlobalAvgPool, MaxPool)
+
+
+def _cbr(b: GraphBuilder, x: str, feats: int, kernel, stride=1,
+         padding="SAME") -> str:
+    x = b.add(Conv2D(feats, kernel, stride, padding, use_bias=False), x)
+    x = b.add(BatchNorm(), x)
+    return b.add(Activation("relu"), x)
+
+
+def _block_a(b: GraphBuilder, x: str, f: int, pool_f: int, idx: int) -> str:
+    b1 = _cbr(b, x, f, 1)
+    b2 = _cbr(b, _cbr(b, x, f * 3 // 4, 1), f, 5)
+    b3 = _cbr(b, _cbr(b, _cbr(b, x, f, 1), f * 3 // 2, 3), f * 3 // 2, 3)
+    b4 = _cbr(b, b.add(AvgPool(3, 1, "SAME"), x), pool_f, 1)
+    return b.add(Concat(), [b1, b2, b3, b4], name=f"mixed_{idx}")
+
+
+def _reduction(b: GraphBuilder, x: str, f: int, idx: int) -> str:
+    b1 = _cbr(b, x, f * 2, 3, stride=2, padding="VALID")
+    b2 = _cbr(b, _cbr(b, _cbr(b, x, f, 1), f, 3), f, 3, stride=2,
+              padding="VALID")
+    b3 = b.add(MaxPool(3, 2, "VALID"), x)
+    return b.add(Concat(), [b1, b2, b3], name=f"mixed_{idx}")
+
+
+def _block_b(b: GraphBuilder, x: str, f: int, out_f: int, idx: int) -> str:
+    b1 = _cbr(b, x, out_f, 1)
+    b2 = _cbr(b, _cbr(b, _cbr(b, x, f, 1), f, (1, 7)), out_f, (7, 1))
+    b3 = _cbr(b, _cbr(b, _cbr(b, _cbr(b, _cbr(
+        b, x, f, 1), f, (7, 1)), f, (1, 7)), f, (7, 1)), out_f, (1, 7))
+    b4 = _cbr(b, b.add(AvgPool(3, 1, "SAME"), x), out_f, 1)
+    return b.add(Concat(), [b1, b2, b3, b4], name=f"mixed_{idx}")
+
+
+def _block_c(b: GraphBuilder, x: str, f: int, idx: int) -> str:
+    b1 = _cbr(b, x, f, 1)
+    mid2 = _cbr(b, x, f, 1)
+    b2 = b.add(Concat(), [_cbr(b, mid2, f, (1, 3)), _cbr(b, mid2, f, (3, 1))])
+    mid3 = _cbr(b, _cbr(b, x, f * 3 // 2, 1), f, 3)
+    b3 = b.add(Concat(), [_cbr(b, mid3, f, (1, 3)), _cbr(b, mid3, f, (3, 1))])
+    b4 = _cbr(b, b.add(AvgPool(3, 1, "SAME"), x), f // 2, 1)
+    return b.add(Concat(), [b1, b2, b3, b4], name=f"mixed_{idx}")
+
+
+def inception(width: int = 64, num_classes: int = 1000,
+              image_size: int = 299, name: str = "inception") -> LayerGraph:
+    w = width
+    b = GraphBuilder(name)
+    x = b.input((image_size, image_size, 3), jnp.float32)
+    # stem
+    x = _cbr(b, x, w // 2, 3, stride=2, padding="VALID")
+    x = _cbr(b, x, w // 2, 3, padding="VALID")
+    x = _cbr(b, x, w, 3)
+    x = b.add(MaxPool(3, 2, "VALID"), x, name="stem_pool")
+    x = _cbr(b, x, w * 5 // 4, 1)
+    x = _cbr(b, x, w * 3, 3, padding="VALID")
+    x = b.add(MaxPool(3, 2, "VALID"), x, name="stem_pool2")
+    # inception stacks
+    idx = 0
+    for _ in range(3):
+        x = _block_a(b, x, w, w // 2, idx)
+        idx += 1
+    x = _reduction(b, x, w * 3, idx)
+    idx += 1
+    for _ in range(4):
+        x = _block_b(b, x, w * 2, w * 3, idx)
+        idx += 1
+    x = _reduction(b, x, w * 3, idx)
+    idx += 1
+    for _ in range(2):
+        x = _block_c(b, x, w * 6, idx)
+        idx += 1
+    x = b.add(GlobalAvgPool(), x, name="avg_pool")
+    x = b.add(Dense(num_classes), x, name="predictions")
+    return b.build()
+
+
+def inception_v3(num_classes: int = 1000, image_size: int = 299) -> LayerGraph:
+    return inception(64, num_classes, image_size, name="inception_v3")
+
+
+def inception_tiny(num_classes: int = 10, image_size: int = 75) -> LayerGraph:
+    return inception(8, num_classes, image_size, name="inception_tiny")
+
+
+#: 6-stage cuts at block boundaries (BASELINE.md config 3)
+INCEPTION_6STAGE_CUTS = ["mixed_0", "mixed_2", "mixed_4", "mixed_6",
+                         "mixed_8"]

@@ -1,1 +1,3 @@
 from .mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh, stage_axis_size
+from .ring_attention import (SEQ_AXIS, full_attention, ring_attention,
+                             sequence_parallel_attention)

@@ -1,0 +1,2 @@
+from .framed import (K_BYTES, K_END, K_TENSOR, TensorClient, TensorServer,
+                     recv_frame, send_end, send_frame)

@@ -1,0 +1,159 @@
+"""Framed stream transport for the host/DCN edge.
+
+The reference's entire distributed backend is a hand-rolled framed TCP
+protocol: 8-byte big-endian length prefix, fixed-size chunking, non-blocking
+sockets parked on select() (reference src/node_state.py:43-101).  In the TPU
+design that role is played by ICI/DCN collectives *inside* the pod; this
+module exists for the edge the collectives don't cover — a remote client
+streaming inference inputs to (and results from) the pipeline host.
+
+Design differences from the reference, on purpose:
+  * Blocking sockets + memoryview scatter/gather writes instead of
+    non-blocking + select-spin: simpler, same throughput, no EAGAIN loops.
+  * One connection carries typed frames (header with kind/shape/dtype/codec)
+    instead of three fixed single-purpose ports (5000/5001/5002,
+    reference src/node.py:17).
+  * Codec is negotiated per frame (raw / blockfloat+lzb), not hardwired,
+    and encode/decode are symmetric (the reference's decode sides are
+    asymmetric — SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any
+
+import numpy as np
+
+from ..codec import BlockFloatCodec, Codec, LosslessCodec, PipelineCodec, RawCodec
+
+#: frame kinds
+K_TENSOR = 1
+K_BYTES = 2
+K_END = 3
+
+_CODECS: dict[str, Codec] = {}
+
+
+def _codec(name: str) -> Codec:
+    if name not in _CODECS:
+        if name == "raw":
+            _CODECS[name] = RawCodec()
+        elif name == "lzb":
+            _CODECS[name] = LosslessCodec()
+        elif name.startswith("bf"):
+            _CODECS[name] = PipelineCodec(bits=int(name[2:]))
+        else:
+            raise ValueError(f"unknown codec {name!r}")
+    return _CODECS[name]
+
+
+# header: kind u8 | codec len u8 | dtype len u8 | ndim u8 | payload len u64
+_HDR = struct.Struct(">BBBBQ")
+MAX_FRAME = 1 << 34  # 16 GiB sanity bound
+
+
+def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
+    """Send one typed frame (tensor or raw bytes)."""
+    if isinstance(arr_or_bytes, (bytes, bytearray, memoryview)):
+        kind, payload = K_BYTES, bytes(arr_or_bytes)
+        meta = b""
+        cname = b"raw"
+        ndim = 0
+    else:
+        arr = np.asarray(arr_or_bytes)
+        kind = K_TENSOR
+        payload = _codec(codec).encode(arr)
+        cname = codec.encode()
+        dt = arr.dtype.str.encode()
+        meta = dt + b"".join(struct.pack(">Q", s) for s in arr.shape)
+        ndim = arr.ndim
+    dt_len = len(meta) - 8 * ndim if kind == K_TENSOR else 0
+    hdr = _HDR.pack(kind, len(cname), dt_len, ndim, len(payload))
+    sock.sendall(hdr + cname + meta + payload)
+
+
+def send_end(sock: socket.socket):
+    sock.sendall(_HDR.pack(K_END, 0, 0, 0, 0))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> tuple[int, Any]:
+    """Receive one frame -> (kind, payload).  Tensor frames are decoded to
+    ndarrays; K_END returns (K_END, None)."""
+    kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if kind == K_END:
+        return K_END, None
+    if plen > MAX_FRAME:
+        raise ValueError(f"frame of {plen} bytes exceeds bound")
+    cname = _recv_exact(sock, clen).decode()
+    if kind == K_BYTES:
+        return K_BYTES, _recv_exact(sock, plen)
+    dt = np.dtype(_recv_exact(sock, dlen).decode())
+    shape = tuple(struct.unpack(">Q", _recv_exact(sock, 8))[0]
+                  for _ in range(ndim))
+    payload = _recv_exact(sock, plen)
+    return K_TENSOR, _codec(cname).decode(payload, shape, dt)
+
+
+class TensorServer:
+    """Accepts one client streaming tensor frames; hands them to a callback
+    and streams result frames back.  This is the host/DCN front door of a
+    pipeline deployment — the role of the dispatcher's paired data socket +
+    result server (reference src/dispatcher.py:85-105), on one connection.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.create_server((host, port))
+        self.address = self._srv.getsockname()
+
+    def serve_once(self, handler, *, codec: str = "raw"):
+        """Accept one client; for each tensor frame, reply with
+        handler(array) as a tensor frame.  Returns after the client's END
+        frame (echoed back)."""
+        conn, _ = self._srv.accept()
+        try:
+            while True:
+                kind, value = recv_frame(conn)
+                if kind == K_END:
+                    send_end(conn)
+                    return
+                send_frame(conn, handler(value), codec=codec)
+        finally:
+            conn.close()
+
+    def close(self):
+        self._srv.close()
+
+
+class TensorClient:
+    """Client side: stream tensors, receive results (strict request/reply)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+
+    def infer(self, arr: np.ndarray, *, codec: str = "raw") -> np.ndarray:
+        send_frame(self._sock, arr, codec=codec)
+        kind, value = recv_frame(self._sock)
+        if kind != K_TENSOR:
+            raise ConnectionError("expected tensor reply")
+        return value
+
+    def close(self):
+        try:
+            send_end(self._sock)
+            recv_frame(self._sock)
+        finally:
+            self._sock.close()

@@ -1,2 +1,4 @@
+from .checkpoint import load_params, save_params
 from .config import DeferConfig
 from .metrics import PipelineMetrics, StopwatchWindow
+from .profiling import profile_pipeline, trace

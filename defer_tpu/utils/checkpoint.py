@@ -1,0 +1,99 @@
+"""Checkpoint save/restore for model parameters.
+
+The reference ships weights once over TCP at startup and holds them in
+memory (reference src/dispatcher.py:57, src/node.py:34) — nothing is ever
+persisted.  Here weights are a first-class checkpointable pytree: orbax when
+available (the TPU-ecosystem standard), with a dependency-free ``.npz``
+format as both fallback and interchange.  Stage placement consumes the same
+pytree (``StageSpec.select_params``), so "restore then deploy" is one line.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+_SEP = "/"
+
+
+def _leaf_key(node: str, path) -> str:
+    """Stable flat key for one pytree leaf (shared by save and load)."""
+    return node + _SEP + _SEP.join(
+        str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def _npz_path(path: str) -> str:
+    # np.savez appends ".npz" to suffix-less paths; normalize so save and
+    # load always agree on the on-disk name
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _flatten(params: dict[str, Any]) -> dict[str, np.ndarray]:
+    flat = {}
+    for node, sub in params.items():
+        leaves_with_paths = jax.tree_util.tree_flatten_with_path(sub)[0]
+        for path, leaf in leaves_with_paths:
+            flat[_leaf_key(node, path)] = np.asarray(leaf)
+    return flat
+
+
+def save_params(path: str, params: dict[str, Any]):
+    """Save a graph parameter pytree to ``<path>`` (npz)."""
+    path = _npz_path(path)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **_flatten(params))
+
+
+def load_params(path: str, like: dict[str, Any]) -> dict[str, Any]:
+    """Restore parameters saved by :func:`save_params`.
+
+    ``like`` provides the target structure (e.g. ``graph.init(key)`` output
+    or its eval_shape); returned arrays match its treedef exactly.  Missing
+    or extra keys fail loudly — a checkpoint/model mismatch should never be
+    silent.
+    """
+    with np.load(_npz_path(path)) as data:
+        stored = dict(data)
+    out: dict[str, Any] = {}
+    expected = _flatten(like)
+    missing = set(expected) - set(stored)
+    extra = set(stored) - set(expected)
+    if missing or extra:
+        raise ValueError(
+            f"checkpoint mismatch: missing={sorted(missing)[:5]} "
+            f"extra={sorted(extra)[:5]}")
+    for node, sub in like.items():
+        leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(sub)
+        leaves = []
+        for path, leaf in leaves_paths:
+            key = _leaf_key(node, path)
+            arr = stored[key]
+            if arr.shape != tuple(np.shape(leaf)):
+                raise ValueError(
+                    f"checkpoint leaf {key!r} has shape {arr.shape}, "
+                    f"model expects {np.shape(leaf)}")
+            leaves.append(arr)
+        out[node] = jax.tree_util.tree_unflatten(treedef, leaves)
+    return out
+
+
+def save_params_orbax(path: str, params: dict[str, Any]):
+    """Orbax-backed save (directory tree checkpoint); requires orbax."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_params_orbax(path: str, like: dict[str, Any]) -> dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    shapes = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        like)
+    return ckptr.restore(os.path.abspath(path), shapes)

@@ -1,0 +1,59 @@
+"""Tracing / profiling hooks.
+
+The reference's observability is throughput arithmetic and ad-hoc prints
+(reference test/test.py:35-36, src/node.py:23); here profiling is a
+first-class wrapper over ``jax.profiler`` plus a structured pipeline
+breakdown that pairs with ``PipelineMetrics``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA/TPU profiler trace (view with tensorboard/xprof)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_pipeline(pipe, params: dict[str, Any], *, iters: int = 20,
+                     warmup: int = 2) -> dict:
+    """Structured breakdown of a pipeline deployment.
+
+    Returns per-stage compute latency, the steady-state step time of the
+    fused pipeline program, the implied stage-imbalance factor (max stage /
+    mean stage — the pipeline's efficiency ceiling), and transfer-buffer
+    footprint.
+    """
+    lat = pipe.stage_latencies(params, iters=iters)
+    inputs = np.zeros((pipe.chunk, pipe.microbatch) + pipe.in_spec.shape,
+                      np.float32)
+    pipe.reset()
+    for _ in range(warmup):
+        pipe.push(inputs, n_real=0)
+    t0 = time.perf_counter()
+    pipe.push(inputs, n_real=0)
+    jax.block_until_ready(pipe._a)
+    step_s = (time.perf_counter() - t0) / pipe.chunk
+    mean_lat = sum(lat) / len(lat)
+    return {
+        "num_stages": pipe.num_stages,
+        "stage_latency_ms": [round(s * 1e3, 4) for s in lat],
+        "stage_imbalance": round(max(lat) / mean_lat, 3) if mean_lat else 0.0,
+        "pipeline_step_ms": round(step_s * 1e3, 4),
+        "step_overhead_vs_max_stage": round(step_s / max(lat), 3)
+        if max(lat) > 0 else 0.0,
+        "buffer_bytes_per_hop": pipe.metrics.buffer_bytes_per_hop,
+        "steady_state_throughput_per_s": round(
+            pipe.microbatch / step_s, 2) if step_s else 0.0,
+    }

@@ -1,0 +1,137 @@
+"""Codec tests: native & NumPy backends, cross-decodability, error bounds.
+
+Capability parity target: the reference's ZFP+LZ4 payload stack
+(reference src/dispatcher.py:81-84) — here symmetric and first-party.
+"""
+
+import numpy as np
+import pytest
+
+from defer_tpu.codec import (BlockFloatCodec, LosslessCodec, PipelineCodec,
+                             RawCodec, native_available)
+
+RNG = np.random.RandomState(42)
+
+
+def test_native_library_builds():
+    assert native_available(), "g++ toolchain present; native codec must load"
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_blockfloat_roundtrip_error_bound(force_numpy):
+    c = BlockFloatCodec(bits=8, force_numpy=force_numpy)
+    x = RNG.randn(3, 57, 11).astype(np.float32) * 10
+    data = c.encode(x)
+    y = c.decode(data, x.shape)
+    # error bounded by block max * 2^-(bits-1)
+    bound = np.abs(x).max() * 2.0 ** -(c.bits - 1) + 1e-7
+    assert np.abs(x - y).max() <= bound
+    # fixed rate: ~bits/32 of float32 size + exponent overhead
+    assert len(data) < x.nbytes * (c.bits / 32.0) * 1.2 + 64
+
+
+def test_blockfloat_cross_backend_compatible():
+    """Native and NumPy implement the identical BFC1 wire format."""
+    cn = BlockFloatCodec(bits=7)
+    cp = BlockFloatCodec(bits=7, force_numpy=True)
+    x = RNG.randn(1000).astype(np.float32)
+    assert cn.encode(x) == cp.encode(x)
+    np.testing.assert_array_equal(cn.decode(cp.encode(x), x.shape),
+                                  cp.decode(cn.encode(x), x.shape))
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_blockfloat_edge_cases(force_numpy):
+    c = BlockFloatCodec(bits=8, force_numpy=force_numpy)
+    for x in [np.zeros((64,), np.float32),
+              np.zeros((0,), np.float32),
+              np.array([1e-30, -1e30, 0, np.inf, -np.inf, np.nan],
+                       np.float32),
+              np.full((65,), 7.25, np.float32)]:
+        y = c.decode(c.encode(x), x.shape)
+        assert y.shape == x.shape
+        finite = np.isfinite(x)
+        # non-finite values are flushed to 0 by design
+        assert np.isfinite(y).all()
+        if finite.all() and x.size:
+            assert np.abs(x - y).max() <= np.abs(x).max() * 2**-7 + 1e-7
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_lossless_roundtrip(force_numpy):
+    c = LosslessCodec(force_numpy=force_numpy)
+    for x in [RNG.randint(0, 255, 10_000).astype(np.uint8),
+              np.tile(np.arange(100, dtype=np.int32), 50),
+              RNG.randn(999).astype(np.float32),
+              np.zeros((4096,), np.float32)]:
+        y = c.decode(c.encode(x), x.shape, x.dtype)
+        np.testing.assert_array_equal(x, y)
+
+
+def test_lzb_compresses_redundancy():
+    c = LosslessCodec()
+    x = np.zeros((100_000,), np.uint8)
+    assert len(c.encode(x)) < 3000  # ~3 bytes per max-length match token
+    text = np.frombuffer(b"the quick brown fox " * 500, np.uint8)
+    assert len(c.encode(text)) < text.size // 5
+
+
+def test_lzb_cross_backend_compatible():
+    cn = LosslessCodec()
+    cp = LosslessCodec(force_numpy=True)
+    x = np.tile(RNG.randint(0, 9, 100).astype(np.uint8), 30)
+    # formats interchange even if greedy matches differ
+    np.testing.assert_array_equal(
+        cn.decode(cp.encode(x), x.shape, x.dtype), x)
+    np.testing.assert_array_equal(
+        cp.decode(cn.encode(x), x.shape, x.dtype), x)
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_pipeline_codec_stack(force_numpy):
+    """The full lz(blockfloat(x)) stack the reference pioneered, symmetric."""
+    c = PipelineCodec(bits=8, force_numpy=force_numpy)
+    x = RNG.randn(32, 56, 56).astype(np.float32)
+    y = c.decode(c.encode(x), x.shape)
+    assert np.abs(x - y).max() <= np.abs(x).max() * 2**-7 + 1e-7
+
+
+def test_corrupt_payloads_rejected():
+    c = PipelineCodec()
+    with pytest.raises(ValueError):
+        c.decode(b"garbage!", (2,))
+    bf = BlockFloatCodec()
+    with pytest.raises(ValueError):
+        bf.decode(b"NOPE" + b"\x00" * 20, (2,))
+    lz = LosslessCodec()
+    with pytest.raises(ValueError):
+        lz.decode(b"LZB1\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff", (2,),
+                  np.uint8)
+
+
+def test_raw_codec():
+    c = RawCodec()
+    x = RNG.randn(5, 5).astype(np.float32)
+    np.testing.assert_array_equal(c.decode(c.encode(x), x.shape, x.dtype), x)
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_blockfloat_extreme_exponents(force_numpy):
+    """Exponent-byte saturation: huge values clamp toward 2^127, subnormal
+    blocks flush toward 0 — never wrap (regression for the e+128 overflow)."""
+    c = BlockFloatCodec(bits=8, force_numpy=force_numpy)
+    huge = np.full((64,), 3e38, np.float32)
+    got = c.decode(c.encode(huge), huge.shape)
+    assert got.max() > 1e38  # same order of magnitude, not 1e-39
+    tiny = np.full((64,), 1e-40, np.float32)
+    got = c.decode(c.encode(tiny), tiny.shape)
+    assert np.abs(got).max() < 1e-30  # flushed toward zero, not 1e+37
+
+
+def test_blockfloat_extreme_cross_backend_identical():
+    cn = BlockFloatCodec(bits=8)
+    cp = BlockFloatCodec(bits=8, force_numpy=True)
+    for x in (np.full((64,), 3e38, np.float32),
+              np.full((64,), 1e-40, np.float32),
+              np.array([2.0**-130, 2.0**127], np.float32)):
+        assert cn.encode(x) == cp.encode(x)

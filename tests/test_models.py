@@ -1,0 +1,96 @@
+"""Model-zoo tests: every BASELINE.md family builds, has the advertised cut
+points, and survives partition-equivalence through the SPMD pipeline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu import (SpmdPipeline, partition, pipeline_mesh,
+                       valid_cut_points)
+from defer_tpu import models as M
+
+
+@pytest.mark.parametrize("factory,in_shape,in_dtype", [
+    (M.vgg_tiny, (32, 32, 3), np.float32),
+    (M.inception_tiny, (75, 75, 3), np.float32),
+    (M.mobilenet_tiny, (32, 32, 3), np.float32),
+    (M.bert_tiny, (16,), np.int32),
+])
+def test_tiny_models_build_and_run(factory, in_shape, in_dtype):
+    g = factory()
+    params = g.init(jax.random.key(0))
+    if in_dtype == np.int32:
+        x = jnp.zeros((2,) + in_shape, jnp.int32) + 3
+    else:
+        x = jax.random.normal(jax.random.key(1), (2,) + in_shape)
+    y = jax.jit(g.apply)(params, x)
+    assert np.isfinite(np.asarray(y, np.float32)).all()
+
+
+@pytest.mark.parametrize("factory,num_stages,in_shape,in_dtype", [
+    (M.vgg_tiny, 4, (32, 32, 3), np.float32),
+    (M.inception_tiny, 6, (75, 75, 3), np.float32),
+    (M.mobilenet_tiny, 2, (32, 32, 3), np.float32),
+    (M.bert_tiny, 4, (16,), np.int32),
+])
+def test_pipeline_equivalence_all_families(factory, num_stages, in_shape,
+                                           in_dtype):
+    """The BASELINE.md configs, tiny-scale: pipeline == single program."""
+    g = factory()
+    params = g.init(jax.random.key(0))
+    stages = partition(g, num_stages=num_stages)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(num_stages),
+                        microbatch=1, chunk=4)
+    rng = np.random.RandomState(0)
+    if in_dtype == np.int32:
+        inputs = rng.randint(0, 99, size=(3, 1) + in_shape).astype(np.int32)
+    else:
+        inputs = rng.randn(3, 1, *in_shape).astype(np.float32)
+    out = pipe.run(inputs)
+    fn = jax.jit(g.apply)
+    ref = np.stack([np.asarray(fn(params, jnp.asarray(x)), np.float32)
+                    for x in inputs])
+    np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_inception_cuts_are_block_boundaries_only():
+    """Branching DAG: nothing inside an inception block is a valid cut —
+    the articulation analysis must only offer stem nodes and mixed_k
+    concats (SURVEY.md §7 hard part 3)."""
+    g = M.inception_tiny()
+    cuts = set(valid_cut_points(g))
+    for idx in range(11):
+        assert f"mixed_{idx}" in cuts
+    # branch-interior nodes of the first A block must not be valid cuts
+    mixed0_inputs = g.predecessors("mixed_0")
+    for n in mixed0_inputs:
+        assert n not in cuts, f"branch tail {n} wrongly valid"
+
+
+def test_advertised_cut_lists_are_valid():
+    for factory, cut_list in [
+        (M.vgg_tiny, None),  # tiny models have different layer counts;
+        (M.bert_tiny, ["block_0", "block_1", "block_2"]),
+    ]:
+        g = factory()
+        if cut_list:
+            stages = partition(g, cut_list)
+            assert len(stages) == len(cut_list) + 1
+
+
+def test_full_size_graphs_build():
+    """Full-size graphs build with correct structure (no params/compute)."""
+    g = M.resnet50()
+    assert g.out_spec("add_15").shape == (7, 7, 2048)
+    assert set(M.RESNET50_8STAGE_CUTS) <= set(valid_cut_points(g))
+    g = M.vgg19()
+    assert g.output_spec.shape == (1000,)
+    assert set(M.VGG19_4STAGE_CUTS) <= set(valid_cut_points(g))
+    g = M.mobilenet_v2()
+    assert set(M.MOBILENETV2_2STAGE_CUTS) <= set(valid_cut_points(g))
+    g = M.bert_base()
+    assert g.output_spec.shape == (768,)
+    assert set(M.BERT_BASE_12STAGE_CUTS) <= set(valid_cut_points(g))
+    g = M.inception_v3()
+    assert set(M.INCEPTION_6STAGE_CUTS) <= set(valid_cut_points(g))

@@ -1,0 +1,93 @@
+"""Framed transport tests: typed frames over a real socket, codec
+negotiation, and an end-to-end remote-client -> pipeline-host loop
+(the host/DCN edge of a deployment)."""
+
+import socket
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from defer_tpu import Defer, DeferConfig
+from defer_tpu.models import resnet_tiny
+from defer_tpu.transport import (K_BYTES, K_TENSOR, TensorClient,
+                                 TensorServer, recv_frame, send_end,
+                                 send_frame)
+
+
+def test_frame_roundtrip_socketpair():
+    a, b = socket.socketpair()
+    x = np.arange(24, dtype=np.int16).reshape(2, 3, 4)
+    send_frame(a, x)
+    kind, y = recv_frame(b)
+    assert kind == K_TENSOR and y.dtype == np.int16
+    np.testing.assert_array_equal(x, y)
+
+    send_frame(a, b"\x00\x01hello")
+    kind, data = recv_frame(b)
+    assert kind == K_BYTES and data == b"\x00\x01hello"
+
+    # big frame exceeds the kernel socket buffer: send from a thread (a
+    # single thread doing sendall-then-recv would deadlock on a socketpair)
+    big = np.random.RandomState(0).randn(300_000).astype(np.float32)
+    sender = threading.Thread(target=send_frame, args=(a, big),
+                              kwargs={"codec": "bf8"})
+    sender.start()
+    _, got = recv_frame(b)
+    sender.join(timeout=30)
+    assert np.abs(big - got).max() <= np.abs(big).max() * 2**-7
+    a.close(); b.close()
+
+
+def test_end_frame():
+    a, b = socket.socketpair()
+    send_end(a)
+    kind, v = recv_frame(b)
+    from defer_tpu.transport import K_END
+    assert kind == K_END and v is None
+    a.close(); b.close()
+
+
+def test_truncated_frame_raises():
+    a, b = socket.socketpair()
+    a.sendall(b"\x01\x03")  # header cut short
+    a.close()
+    with pytest.raises(ConnectionError):
+        recv_frame(b)
+    b.close()
+
+
+def test_remote_edge_end_to_end():
+    """A remote client streams inputs to a pipeline host over TCP with the
+    lossy codec; the host runs the SPMD pipeline and streams back results —
+    full capability parity with the reference's deployment
+    (dispatcher <-> TCP <-> compute chain), with the chain inside one pod."""
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2))
+    pipe = defer.build(g, params, num_stages=2)
+
+    server = TensorServer()
+    host, port = server.address
+
+    def handler(x):
+        return pipe.run(x[None])[0]
+
+    t = threading.Thread(target=server.serve_once,
+                         kwargs={"handler": handler, "codec": "raw"},
+                         daemon=True)
+    t.start()
+
+    client = TensorClient(host, port)
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(1, 32, 32, 3).astype(np.float32) for _ in range(3)]
+    results = [client.infer(x, codec="bf12") for x in xs]
+    client.close()
+    t.join(timeout=30)
+    server.close()
+
+    fn = jax.jit(g.apply)
+    for x, r in zip(xs, results):
+        ref = np.asarray(fn(params, x), np.float32)
+        np.testing.assert_allclose(r, ref, rtol=2e-3, atol=2e-3)
