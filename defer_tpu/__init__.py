@@ -21,6 +21,7 @@ from . import models
 from .graph.analysis import auto_cut_points, total_flops, valid_cut_points
 from .graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
 from .graph.viz import summary, to_dot
+from .ops import flash_attention
 from .codec import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
                     RawCodec)
 from .parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
@@ -46,6 +47,7 @@ __all__ = [
     "SpmdPipeline", "MpmdPipeline", "Defer", "DeferHandle", "DeferConfig",
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
     "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",
+    "flash_attention",
     "Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec", "RawCodec",
     "save_params", "load_params", "profile_pipeline", "trace",
 ]

@@ -86,9 +86,11 @@ def _bf_compress_np(x: np.ndarray, bits: int) -> bytes:
                           bitorder="little")
 
     header = b"BFC1" + int(n).to_bytes(8, "little") + bytes([bits, 0, 0, 0])
-    body = np.concatenate(
-        [np.concatenate([np.array([e_ + 128], np.uint8), row])
-         for e_, row in zip(e, payload)]) if nblocks else np.zeros(0, np.uint8)
+    if nblocks:
+        body = np.concatenate(
+            [(e + 128).astype(np.uint8)[:, None], payload], axis=1).ravel()
+    else:
+        body = np.zeros(0, np.uint8)
     return header + body.tobytes()
 
 
@@ -146,6 +148,7 @@ class BlockFloatCodec(Codec):
         return out[:written].tobytes()
 
     def decode(self, data, shape, dtype=np.float32):
+        expected = int(np.prod(shape, dtype=np.int64))
         if self._lib is None:
             flat = _bf_decompress_np(data)
         else:
@@ -155,6 +158,12 @@ class BlockFloatCodec(Codec):
                 buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), buf.size)
             if n < 0:
                 raise ValueError("not a BFC1 payload")
+            if n != expected:
+                # validate the header count against the caller's shape BEFORE
+                # allocating: a corrupt/hostile 20-byte payload could other-
+                # wise declare a multi-terabyte output
+                raise ValueError(
+                    f"BFC1 payload declares {n} values, expected {expected}")
             flat = np.empty(n, np.float32)
             got = lib.bf_decompress(
                 buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), buf.size,
@@ -268,14 +277,23 @@ def _lzb_compress(data: bytes, lib) -> bytes:
     return out[:written].tobytes()
 
 
-def _lzb_decompress(data: bytes, lib) -> bytes:
+def _lzb_decompress(data: bytes, lib, expected: int | None = None) -> bytes:
     if lib is None:
-        return _lzb_decompress_py(data)
+        out = _lzb_decompress_py(data)
+        if expected is not None and len(out) != expected:
+            raise ValueError(
+                f"LZB1 payload is {len(out)} bytes, expected {expected}")
+        return out
     src = np.frombuffer(data, np.uint8)
     n = lib.lzb_decompressed_size(
         src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), src.size)
     if n < 0:
         raise ValueError("not an LZB1 payload")
+    if expected is not None and n != expected:
+        # bound the allocation by what the caller expects — a hostile header
+        # must not pick the output size
+        raise ValueError(
+            f"LZB1 payload declares {n} bytes, expected {expected}")
     out = np.empty(n, np.uint8)
     got = lib.lzb_decompress(
         src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), src.size,
@@ -300,7 +318,11 @@ class PipelineCodec(Codec):
         return _lzb_compress(self._bf.encode(arr), self._lib)
 
     def decode(self, data, shape, dtype=np.float32):
-        return self._bf.decode(_lzb_decompress(data, self._lib), shape, dtype)
+        n = int(np.prod(shape, dtype=np.int64))
+        nblocks = (n + BF_BLOCK - 1) // BF_BLOCK
+        expected = 16 + nblocks * (1 + (BF_BLOCK * self._bf.bits + 7) // 8)
+        return self._bf.decode(
+            _lzb_decompress(data, self._lib, expected=expected), shape, dtype)
 
 
 class LosslessCodec(Codec):
@@ -315,5 +337,6 @@ class LosslessCodec(Codec):
         return _lzb_compress(np.ascontiguousarray(arr).tobytes(), self._lib)
 
     def decode(self, data, shape, dtype):
-        raw = _lzb_decompress(data, self._lib)
+        expected = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        raw = _lzb_decompress(data, self._lib, expected=expected)
         return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()

@@ -323,6 +323,9 @@ class TransformerBlock(Op):
 
     num_heads: int
     mlp_ratio: int = 4
+    #: "auto" = Pallas flash attention on TPU / plain XLA elsewhere;
+    #: "flash" and "xla" force one implementation
+    attn_impl: str = "auto"
 
     def init(self, key, in_specs):
         (spec,) = in_specs
@@ -365,9 +368,19 @@ class TransformerBlock(Op):
         q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-        att = jax.nn.softmax(att, axis=-1)
-        y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if impl not in ("flash", "xla"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'flash' or 'xla', got {impl!r}")
+        if impl == "flash":
+            from ..ops import flash_attention
+            y = flash_attention(q, k, v)
+        else:
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+            att = jax.nn.softmax(att, axis=-1)
+            y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 

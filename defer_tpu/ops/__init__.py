@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference delegates all compute to Keras ``model.predict`` (reference
+src/node.py:106); here the few ops that dominate wall-clock get hand-tiled
+Pallas kernels (MXU-aligned blocks, VMEM-resident working set), with the
+plain-XLA implementations as the fallback everywhere else.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,165 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+The reference framework has no attention at all (CNN workloads only —
+SURVEY.md §2.3); attention is first-class here because the BERT-Base/12
+baseline config and the long-context (ring attention) path both spend their
+FLOPs in it.  This kernel computes exact softmax attention in O(T) memory by
+streaming K/V blocks through VMEM with an online-softmax accumulator —
+neither the score matrix [Tq, Tk] nor the full K/V sequence is ever resident
+on-chip.
+
+Tiling: grid = (batch*heads, Tq/block_q, Tk/block_k) with the K axis
+innermost; Pallas DMAs one [block_k, d] K/V tile per step while the
+(running max, running denominator, rescaled accumulator) state persists in
+VMEM scratch across the sequential K iterations.  Both matmuls per block
+(QK^T and PV) hit the MXU at [block_q, d] x [d, block_k] and
+[block_q, block_k] x [block_k, d].
+
+Causal masking uses bottom-right alignment: query row i attends to key
+positions <= i + (Tk - Tq), so decode-style calls (Tq=1 against a long K/V
+prefix) attend to the whole prefix.
+
+On non-TPU backends (CPU tests) the same kernel runs in interpreter mode, so
+there is exactly one implementation of the math.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+#: lane width of the m/l scratch rows (per-row scalars broadcast across it)
+_LANES = 128
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                 scale, block_q, block_k, num_kb, t_q, t_k, causal):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # bottom-right causal alignment: q row r is global position
+    # r + qi*block_q + (t_k - t_q) in key coordinates
+    causal_off = t_k - t_q
+    if causal:
+        # this K block is fully in the future of every query row -> skip
+        live = kb * block_k <= qi * block_q + block_q - 1 + causal_off
+    else:
+        live = True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < t_k  # drop key padding
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + causal_off
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        # rows with no unmasked key yet carry m = -inf; keep them inert
+        safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - safe_m))
+        p = jnp.where(mask, jnp.exp(s - safe_m), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-20)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    rem = -size % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "block_q", "block_k", "interpret"))
+def flash_attention(q, k, v, *, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Exact attention ``softmax(q kᵀ/√d) v`` without materializing scores.
+
+    q: [B, H, Tq, D]; k, v: [B, H, Tk, D].  Any sizes — inputs are padded to
+    MXU-aligned tiles internally and the padding is masked out of the
+    softmax.  ``causal=True`` with Tq != Tk uses bottom-right alignment
+    (decode semantics).  ``interpret=None`` auto-selects interpreter mode
+    off-TPU so tests exercise the identical kernel on CPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    orig_dtype = q.dtype
+
+    block_q = min(block_q, max(8, 1 << (t_q - 1).bit_length()))
+    block_k = min(block_k, max(8, 1 << (t_k - 1).bit_length()))
+
+    qp = _pad_to(q.reshape(b * h, t_q, d), 1, block_q)
+    kp = _pad_to(k.reshape(b * h, t_k, d), 1, block_k)
+    vp = _pad_to(v.reshape(b * h, t_k, d), 1, block_k)
+    # pad head dim to the 128-lane boundary (zeros are exact: they add
+    # nothing to q·k scores and the extra output columns are sliced off)
+    qp, kp, vp = (_pad_to(x, 2, _LANES) for x in (qp, kp, vp))
+    dp = qp.shape[-1]
+    tqp, tkp = qp.shape[1], kp.shape[1]
+    num_qb, num_kb = tqp // block_q, tkp // block_k
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        num_kb=num_kb, t_q=t_q, t_k=t_k, causal=causal)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda bh, qi, kb: (bh, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp),
+                               lambda bh, qi, kb: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tqp, dp), orig_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, dp), jnp.float32),      # value accumulator
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+
+    return out[:, :t_q, :d].reshape(b, h, t_q, d)

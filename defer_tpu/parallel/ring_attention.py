@@ -98,12 +98,17 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
 
 
 def full_attention(q, k, v, *, causal: bool = False):
-    """Reference single-device attention (for equivalence tests)."""
+    """Reference single-device attention (for equivalence tests).
+
+    ``causal`` uses bottom-right alignment when Tq != Tk (query row i sees
+    key positions <= i + Tk - Tq), matching ``flash_attention`` decode
+    semantics."""
     d = q.shape[-1]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
     if causal:
-        t = q.shape[2]
-        mask = jnp.tril(jnp.ones((t, t), bool))
+        tq, tk = q.shape[2], k.shape[2]
+        q_pos = jnp.arange(tq)[:, None] + (tk - tq)
+        mask = q_pos >= jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -41,6 +41,7 @@ def profile_pipeline(pipe, params: dict[str, Any], *, iters: int = 20,
     pipe.reset()
     for _ in range(warmup):
         pipe.push(inputs, n_real=0)
+    jax.block_until_ready(pipe._a)  # don't bill queued warmup work to t0
     t0 = time.perf_counter()
     pipe.push(inputs, n_real=0)
     jax.block_until_ready(pipe._a)

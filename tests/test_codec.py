@@ -135,3 +135,19 @@ def test_blockfloat_extreme_cross_backend_identical():
               np.full((64,), 1e-40, np.float32),
               np.array([2.0**-130, 2.0**127], np.float32)):
         assert cn.encode(x) == cp.encode(x)
+
+
+@pytest.mark.parametrize("force_numpy", [False, True])
+def test_hostile_size_headers_rejected_before_allocating(force_numpy):
+    """A tiny payload whose header declares a multi-terabyte output must be
+    rejected by validating against the caller's expected shape — not by
+    attempting the allocation."""
+    bomb_bf = (b"BFC1" + (2 ** 40).to_bytes(8, "little")
+               + bytes([8, 0, 0, 0]))
+    with pytest.raises(ValueError):
+        BlockFloatCodec(bits=8, force_numpy=force_numpy).decode(
+            bomb_bf, (64,))
+    c = LosslessCodec(force_numpy=force_numpy)
+    payload = c.encode(np.zeros(64, np.uint8))
+    with pytest.raises(ValueError):
+        c.decode(payload, (2 ** 40,), np.uint8)  # size mismatch, no alloc

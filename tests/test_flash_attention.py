@@ -1,0 +1,119 @@
+"""Flash-attention kernel: equivalence with reference attention.
+
+Runs the identical Pallas kernel in interpreter mode on the CPU backend
+(SURVEY.md §4: fake-backend testing), so the math under test is exactly what
+compiles for the MXU on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from defer_tpu.ops import flash_attention
+from defer_tpu.parallel.ring_attention import full_attention
+
+# default CPU matmuls may run at reduced precision; the comparison below is
+# between two f32 implementations, so the tolerance covers that
+TOL = 5e-3
+
+
+@pytest.mark.parametrize("shape,causal", [
+    ((2, 3, 64, 64, 16), False),
+    ((1, 2, 100, 100, 24), True),     # non-multiple of block: padding path
+    ((2, 2, 37, 53, 8), False),       # Tq != Tk
+    ((1, 1, 130, 130, 64), True),     # spills into a second q block
+])
+def test_matches_reference(shape, causal):
+    b, h, tq, tk, d = shape
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, h, tq, d))
+    k = jax.random.normal(ks[1], (b, h, tk, d))
+    v = jax.random.normal(ks[2], (b, h, tk, d))
+    out = flash_attention(q, k, v, causal=causal)
+    ref = full_attention(q, k, v, causal=causal)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=TOL, rtol=TOL)
+
+
+def test_multi_block_k_loop():
+    """Accumulation across several K/V blocks (the online-softmax carry)."""
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (1, 2, 32, 16))
+    k = jax.random.normal(ks[1], (1, 2, 96, 16))
+    v = jax.random.normal(ks[2], (1, 2, 96, 16))
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=TOL, rtol=TOL)
+
+
+def test_causal_masks_future():
+    """Output at position t must not depend on keys/values after t."""
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], (1, 1, 16, 8))
+    k = jax.random.normal(ks[1], (1, 1, 16, 8))
+    v = jax.random.normal(ks[2], (1, 1, 16, 8))
+    out1 = flash_attention(q, k, v, causal=True)
+    # perturb the last key/value; all but the last position must be unchanged
+    k2 = k.at[:, :, -1].add(7.0)
+    v2 = v.at[:, :, -1].add(-3.0)
+    out2 = flash_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :, :-1]),
+                               np.asarray(out2[:, :, :-1]), atol=1e-6)
+    assert not np.allclose(np.asarray(out1[:, :, -1]),
+                           np.asarray(out2[:, :, -1]))
+
+
+def test_causal_decode_attends_to_full_prefix():
+    """Tq=1 against a long K/V prefix (KV-cache decode): bottom-right
+    causal alignment must admit every prefix position."""
+    ks = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(ks[0], (1, 2, 1, 16))
+    k = jax.random.normal(ks[1], (1, 2, 48, 16))
+    v = jax.random.normal(ks[2], (1, 2, 48, 16))
+    out = flash_attention(q, k, v, causal=True)
+    ref = full_attention(q, k, v, causal=False)  # full prefix visible
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=TOL, rtol=TOL)
+    # chunked-decode shape (Tq=5 against Tk=48): bottom-right alignment,
+    # oracle is full_attention's own bottom-right causal mask
+    q5 = jax.random.normal(ks[0], (1, 2, 5, 16))
+    out5 = flash_attention(q5, k, v, causal=True)
+    ref5 = full_attention(q5, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out5), np.asarray(ref5),
+                               atol=TOL, rtol=TOL)
+
+
+def test_bfloat16_io():
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (1, 2, 64, 32), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 2, 64, 32), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 2, 64, 32), jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = full_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_transformer_block_flash_matches_xla():
+    """The graph-level TransformerBlock gives the same output under both
+    attention implementations."""
+    from defer_tpu.graph.ir import GraphBuilder
+    from defer_tpu.graph.ops import TransformerBlock
+
+    outs = {}
+    for impl in ("xla", "flash"):
+        b = GraphBuilder(f"blk_{impl}")
+        x = b.input((24, 32), jnp.float32)
+        y = b.add(TransformerBlock(num_heads=2, attn_impl=impl), x,
+                  name="blk")
+        g = b.build()
+        params = g.init(jax.random.key(4))
+        xin = jax.random.normal(jax.random.key(5), (2, 24, 32))
+        outs[impl] = np.asarray(g.apply(params, xin))
+    np.testing.assert_allclose(outs["flash"], outs["xla"],
+                               atol=TOL, rtol=TOL)

@@ -70,13 +70,11 @@ def test_inception_cuts_are_block_boundaries_only():
 
 def test_advertised_cut_lists_are_valid():
     for factory, cut_list in [
-        (M.vgg_tiny, None),  # tiny models have different layer counts;
         (M.bert_tiny, ["block_0", "block_1", "block_2"]),
     ]:
         g = factory()
-        if cut_list:
-            stages = partition(g, cut_list)
-            assert len(stages) == len(cut_list) + 1
+        stages = partition(g, cut_list)
+        assert len(stages) == len(cut_list) + 1
 
 
 def test_full_size_graphs_build():
