@@ -11,7 +11,9 @@ Prints exactly one JSON line on stdout:
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -22,6 +24,44 @@ import jax.numpy as jnp
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def init_devices():
+    """``jax.devices()`` with a wedged-tunnel escape hatch.
+
+    This environment reaches its one TPU chip through a remote PJRT tunnel
+    that admits one client at a time; if a previous client died without
+    releasing its claim, backend init blocks indefinitely.  Run the init in
+    a daemon thread with a timeout and, on timeout, re-exec this script
+    pinned to an 8-virtual-device CPU backend so a benchmark line is always
+    produced (same code path, smaller model).
+    """
+    if os.environ.get("DEFER_BENCH_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+    timeout_s = float(os.environ.get("DEFER_BENCH_TPU_TIMEOUT_S", "600"))
+    box = {}
+
+    def _init():
+        try:
+            box["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — report and fall back
+            box["error"] = e
+
+    th = threading.Thread(target=_init, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if "devices" in box:
+        return box["devices"]
+    log(f"bench: device init failed ({box.get('error', 'timed out')}); "
+        f"re-exec on CPU fallback")
+    env = dict(os.environ)
+    env["DEFER_BENCH_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 def timed_window(fn, *, min_iters=8, min_s=3.0, max_iters=512):
@@ -41,7 +81,7 @@ def main():
     from defer_tpu import SpmdPipeline, partition, pipeline_mesh
     from defer_tpu.models import resnet50, resnet_tiny, RESNET50_8STAGE_CUTS
 
-    devices = jax.devices()
+    devices = init_devices()
     n = len(devices)
     platform = devices[0].platform
     on_tpu = platform == "tpu"

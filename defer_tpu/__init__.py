@@ -27,6 +27,8 @@ from .codec import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
 from .parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
 from .parallel.ring_attention import (SEQ_AXIS, ring_attention,
                                       sequence_parallel_attention)
+from .parallel.tensor import (MODEL_AXIS, shard_tp_params,
+                              tensor_parallel_fn, tensor_parallel_mesh)
 from .partition.partitioner import partition
 from .partition.stage import StageSpec
 from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
@@ -48,6 +50,8 @@ __all__ = [
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
     "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",
     "flash_attention",
+    "MODEL_AXIS", "shard_tp_params", "tensor_parallel_fn",
+    "tensor_parallel_mesh",
     "Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec", "RawCodec",
     "save_params", "load_params", "profile_pipeline", "trace",
 ]

@@ -151,6 +151,10 @@ class BlockFloatCodec(Codec):
         expected = int(np.prod(shape, dtype=np.int64))
         if self._lib is None:
             flat = _bf_decompress_np(data)
+            if flat.size != expected:
+                raise ValueError(
+                    f"BFC1 payload declares {flat.size} values, "
+                    f"expected {expected}")
         else:
             lib = self._lib
             buf = np.frombuffer(data, np.uint8)
@@ -279,6 +283,15 @@ def _lzb_compress(data: bytes, lib) -> bytes:
 
 def _lzb_decompress(data: bytes, lib, expected: int | None = None) -> bytes:
     if lib is None:
+        if expected is not None:
+            # validate the declared size BEFORE decompressing — a hostile
+            # ~30-byte header must not drive an unbounded output loop
+            if len(data) < 5 or data[:4] != b"LZB1":
+                raise ValueError("not an LZB1 payload")
+            n, _ = _get_varint(data, 4)
+            if n != expected:
+                raise ValueError(
+                    f"LZB1 payload declares {n} bytes, expected {expected}")
         out = _lzb_decompress_py(data)
         if expected is not None and len(out) != expected:
             raise ValueError(

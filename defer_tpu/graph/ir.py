@@ -78,6 +78,21 @@ class Op:
         del in_specs
         return out_spec.size  # elementwise default
 
+    # -- tensor parallelism (parallel/tensor.py) ---------------------------
+    # Default: parameters replicated, apply unchanged.  Matmul-bearing ops
+    # override both to shard weights over the "model" mesh axis.
+
+    def tp_shard(self, params: Params, tp: int, rank: int) -> Params:
+        """Rank ``rank``'s shard of ``params`` for ``tp``-way TP."""
+        del tp, rank
+        return params
+
+    def tp_apply(self, params: Params, *xs: jax.Array,
+                 axis_name: str | None = None, tp: int = 1) -> jax.Array:
+        """Forward on TP-sharded params; must psum partial results."""
+        del axis_name, tp
+        return self.apply(params, *xs)
+
     def __repr__(self):
         return type(self).__name__
 

@@ -60,6 +60,31 @@ class Dense(Op):
         (spec,) = in_specs
         return 2 * spec.size * self.features
 
+    # -- tensor parallelism: row-parallel (input dim sharded, one psum) ----
+
+    def tp_shard(self, params, tp, rank):
+        w = params["w"]
+        d = w.shape[0]
+        if d % tp:
+            raise ValueError(f"Dense input dim {d} not divisible by tp={tp}")
+        blk = d // tp
+        out = {"w": w[rank * blk:(rank + 1) * blk]}
+        if self.use_bias:
+            out["b"] = params["b"]  # replicated; added once after the psum
+        return out
+
+    def tp_apply(self, params, x, *, axis_name=None, tp=1):
+        if axis_name is None or tp == 1:
+            return self.apply(params, x)
+        p = _cast(params, x.dtype)
+        blk = p["w"].shape[0]
+        idx = lax.axis_index(axis_name)
+        xs = lax.dynamic_slice_in_dim(x, idx * blk, blk, axis=x.ndim - 1)
+        y = lax.psum(xs @ p["w"], axis_name)
+        if self.use_bias:
+            y = y + p["b"]
+        return y
+
 
 @dataclasses.dataclass(frozen=True, repr=False)
 class Conv2D(Op):
@@ -356,6 +381,22 @@ class TransformerBlock(Op):
         return (x - mu) * lax.rsqrt(var + jnp.asarray(eps, x.dtype)) \
             * p["scale"] + p["bias"]
 
+    def _attend(self, q, k, v):
+        """Scaled-dot-product attention on [b, nh, t, hd] (impl dispatch)."""
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if impl not in ("flash", "xla"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'flash' or 'xla', got {impl!r}")
+        if impl == "flash":
+            from ..ops import flash_attention
+            return flash_attention(q, k, v)
+        hd = q.shape[-1]
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+        att = jax.nn.softmax(att, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", att, v)
+
     def apply(self, params, x):
         p = _cast(params, x.dtype)
         b, t, d = x.shape
@@ -368,19 +409,7 @@ class TransformerBlock(Op):
         q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        impl = self.attn_impl
-        if impl == "auto":
-            impl = "flash" if jax.default_backend() == "tpu" else "xla"
-        if impl not in ("flash", "xla"):
-            raise ValueError(
-                f"attn_impl must be 'auto', 'flash' or 'xla', got {impl!r}")
-        if impl == "flash":
-            from ..ops import flash_attention
-            y = flash_attention(q, k, v)
-        else:
-            att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-            att = jax.nn.softmax(att, axis=-1)
-            y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        y = self._attend(q, k, v)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 
@@ -392,3 +421,134 @@ class TransformerBlock(Op):
         (spec,) = in_specs
         t, d = spec.shape
         return 2 * t * d * (4 * d + 2 * self.mlp_ratio * d) + 4 * t * t * d
+
+    # -- tensor parallelism: Megatron column->row pairing, heads sharded ---
+
+    def tp_shard(self, params, tp, rank):
+        if self.num_heads % tp:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by tp={tp}")
+        d = params["qkv"]["w"].shape[0]
+        h = params["fc1"]["w"].shape[1]
+        if h % tp:
+            raise ValueError(f"mlp width {h} not divisible by tp={tp}")
+        blk, hblk = d // tp, h // tp
+
+        def qkv_cols(a):
+            # per-chunk (q,k,v) column slice so each rank gets whole heads
+            parts = [a[..., i * d + rank * blk: i * d + (rank + 1) * blk]
+                     for i in range(3)]
+            return jnp.concatenate(parts, axis=-1)
+
+        return {
+            "ln1": params["ln1"],
+            "qkv": {"w": qkv_cols(params["qkv"]["w"]),
+                    "b": qkv_cols(params["qkv"]["b"])},
+            "proj": {"w": params["proj"]["w"][rank * blk:(rank + 1) * blk],
+                     "b": params["proj"]["b"]},
+            "ln2": params["ln2"],
+            "fc1": {"w": params["fc1"]["w"][:, rank * hblk:(rank + 1) * hblk],
+                    "b": params["fc1"]["b"][rank * hblk:(rank + 1) * hblk]},
+            "fc2": {"w": params["fc2"]["w"][rank * hblk:(rank + 1) * hblk],
+                    "b": params["fc2"]["b"]},
+        }
+
+    def tp_apply(self, params, x, *, axis_name=None, tp=1):
+        if axis_name is None or tp == 1:
+            return self.apply(params, x)
+        p = _cast(params, x.dtype)
+        b, t, d = x.shape
+        nh = self.num_heads // tp           # local heads
+        dl = p["qkv"]["w"].shape[1] // 3    # local head-group width d/tp
+        hd = dl // nh
+
+        y = self._ln(p["ln1"], x)
+        qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        y = self._attend(q, k, v)
+        y = y.transpose(0, 2, 1, 3).reshape(b, t, dl)
+        x = x + lax.psum(y @ p["proj"]["w"], axis_name) + p["proj"]["b"]
+
+        y = self._ln(p["ln2"], x)
+        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
+        return x + lax.psum(y @ p["fc2"]["w"], axis_name) + p["fc2"]["b"]
+
+
+# ---------------------------------------------------------------------------
+# mixture of experts (expert parallelism rides parallel/expert.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MoE(Op):
+    """Switch-style top-1 mixture-of-experts FFN (with residual).
+
+    Single-device ``apply`` evaluates every expert and masks (exact, fine
+    for the MXU at small E); the expert-parallel path — experts sharded over
+    an "expert" mesh axis with capacity-based ``all_to_all`` token dispatch —
+    lives in :mod:`defer_tpu.parallel.expert` and is numerically identical
+    whenever no token exceeds capacity.
+    """
+
+    num_experts: int
+    hidden: int
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        e, h = self.num_experts, self.hidden
+        ks = jax.random.split(key, 3)
+        return {
+            "gate": jax.random.normal(ks[0], (d, e), jnp.float32) * 0.02,
+            "fc1": {"w": jax.random.normal(ks[1], (e, d, h), jnp.float32)
+                    / math.sqrt(d),
+                    "b": jnp.zeros((e, h), jnp.float32)},
+            "fc2": {"w": jax.random.normal(ks[2], (e, h, d), jnp.float32)
+                    / math.sqrt(h),
+                    "b": jnp.zeros((e, d), jnp.float32)},
+        }
+
+    def route(self, params, x):
+        """Top-1 routing: (expert_id [b,t], gate_prob [b,t])."""
+        logits = x @ params["gate"].astype(x.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        eid = jnp.argmax(logits, axis=-1)
+        pe = jnp.take_along_axis(probs, eid[..., None], axis=-1)[..., 0]
+        return eid, pe
+
+    def expert_fn(self, params, x, eid):
+        """Run expert ``eid`` (array, broadcastable) on tokens ``x``.
+
+        ``params`` holds stacked expert weights [E_local, ...]; ``eid``
+        indexes into that local stack.
+        """
+        fc1 = params["fc1"]
+        fc2 = params["fc2"]
+        w1 = fc1["w"][eid].astype(x.dtype)
+        b1 = fc1["b"][eid].astype(x.dtype)
+        w2 = fc2["w"][eid].astype(x.dtype)
+        b2 = fc2["b"][eid].astype(x.dtype)
+        h = jax.nn.gelu(jnp.einsum("...d,...dh->...h", x, w1) + b1)
+        return jnp.einsum("...h,...hd->...d", h, w2) + b2
+
+    def apply(self, params, x):
+        eid, pe = self.route(params, x)
+        b, t, d = x.shape
+        e = self.num_experts
+        h1 = jax.nn.gelu(
+            jnp.einsum("btd,edh->bteh", x, params["fc1"]["w"].astype(x.dtype))
+            + params["fc1"]["b"].astype(x.dtype))
+        y = (jnp.einsum("bteh,ehd->bted", h1,
+                        params["fc2"]["w"].astype(x.dtype))
+             + params["fc2"]["b"].astype(x.dtype))
+        sel = jax.nn.one_hot(eid, e, dtype=x.dtype)
+        return x + (y * sel[..., None]).sum(axis=2) * pe[..., None]
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        t, d = spec.shape
+        # effective top-1 cost: one expert per token
+        return 2 * t * d * (2 * self.hidden) + 2 * t * d * self.num_experts

@@ -1,3 +1,5 @@
 from .mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh, stage_axis_size
 from .ring_attention import (SEQ_AXIS, full_attention, ring_attention,
                              sequence_parallel_attention)
+from .tensor import (MODEL_AXIS, shard_tp_params, tensor_parallel_fn,
+                     tensor_parallel_mesh)
