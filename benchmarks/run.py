@@ -1,0 +1,170 @@
+"""Benchmark suite over the five BASELINE.md configs.
+
+Reproduces the reference's measurement protocol per config — timed-window
+streaming throughput of the pipelined deployment (reference test/test.py:
+25-37) against a single-device predict loop (reference test/local_infer.py:
+16-23) — and adds the per-stage metrics the reference never had: stage
+latency, duty cycle (energy analogue), bubble fraction.
+
+One JSON line per config on stdout; human detail on stderr.
+
+Usage:
+  python benchmarks/run.py                  # all configs, device-appropriate
+  python benchmarks/run.py --configs resnet50_8,bert_base_12
+  python benchmarks/run.py --tiny           # force tiny models (CPU smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from defer_tpu import SpmdPipeline, partition, pipeline_mesh  # noqa: E402
+from defer_tpu import models  # noqa: E402
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+#: name -> (full_model_fn, full_cuts, full_in_shape, full_dtype,
+#:          tiny_model_fn, tiny_stages, tiny_in_shape, tiny_dtype)
+CONFIGS = {
+    "resnet50_8": (
+        models.resnet50, models.RESNET50_8STAGE_CUTS, (224, 224, 3), "f",
+        models.resnet_tiny, 8, (32, 32, 3), "f"),
+    "vgg19_4": (
+        models.vgg19, models.VGG19_4STAGE_CUTS, (224, 224, 3), "f",
+        models.vgg_tiny, 4, (32, 32, 3), "f"),
+    "inceptionv3_6": (
+        models.inception_v3, models.INCEPTION_6STAGE_CUTS, (299, 299, 3), "f",
+        models.inception_tiny, 6, (75, 75, 3), "f"),
+    "mobilenetv2_2": (
+        models.mobilenet_v2, models.MOBILENETV2_2STAGE_CUTS, (224, 224, 3),
+        "f", models.mobilenet_tiny, 2, (32, 32, 3), "f"),
+    "bert_base_12": (
+        models.bert_base, models.BERT_BASE_12STAGE_CUTS, (128,), "i",
+        models.bert_tiny, 4, (16,), "i"),
+}
+
+
+def timed(fn, *, min_iters=8, min_s=2.0, max_iters=256):
+    fn()
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        fn()
+        n += 1
+        dt = time.perf_counter() - t0
+        if (n >= min_iters and dt >= min_s) or n >= max_iters:
+            return dt / n
+
+
+def sample(shape, kind, microbatch, lead=()):
+    full = lead + (microbatch,) + shape
+    if kind == "i":
+        return (np.arange(int(np.prod(full))).reshape(full) % 100
+                ).astype(np.float32)
+    return np.zeros(full, np.float32)
+
+
+def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool):
+    (full_fn, full_cuts, full_shape, full_kind,
+     tiny_fn, tiny_stages, tiny_shape, tiny_kind) = CONFIGS[name]
+    on_tpu = jax.default_backend() == "tpu"
+    use_full = on_tpu and not tiny
+    n_dev = len(jax.devices())
+
+    if use_full:
+        graph, in_shape, kind = full_fn(), full_shape, full_kind
+        cuts, num_stages = full_cuts, None
+        want = len(full_cuts) + 1
+    else:
+        graph, in_shape, kind = tiny_fn(), tiny_shape, tiny_kind
+        cuts, num_stages = None, min(tiny_stages, n_dev)
+        want = num_stages
+    if want > n_dev:
+        cuts, num_stages, want = None, n_dev, n_dev
+        log(f"{name}: only {n_dev} devices; auto-partitioning to {n_dev}")
+
+    params = graph.init(jax.random.key(0))
+    compute_dtype = jnp.bfloat16 if on_tpu and kind == "f" else None
+
+    # single-device baseline (reference test/local_infer.py semantics)
+    x1 = jnp.asarray(sample(in_shape, kind, 1))
+    if kind == "i":
+        x1 = x1.astype(jnp.int32)
+    fwd = jax.jit(graph.apply)
+    params_c = (jax.tree.map(lambda a: a.astype(compute_dtype), params)
+                if compute_dtype else params)
+    base_s = timed(lambda: jax.block_until_ready(fwd(params_c, x1)))
+
+    stages = partition(graph, cuts, num_stages=num_stages)
+    pipe = SpmdPipeline(
+        stages, params, mesh=pipeline_mesh(len(stages)), microbatch=1,
+        chunk=chunk,
+        buffer_dtype=jnp.bfloat16 if on_tpu and kind == "f" else jnp.float32,
+        compute_dtype=compute_dtype)
+    xs = sample(in_shape, kind, 1, lead=(chunk,))
+
+    def push_chunk():
+        pipe.push(xs, n_real=chunk)
+        jax.block_until_ready(pipe._a)
+
+    pipe.reset()
+    pipe_s = timed(push_chunk) / chunk
+    if stage_lat:
+        pipe.stage_latencies(params)
+
+    m = pipe.metrics.as_dict()
+    result = {
+        "metric": f"{name}{'_tiny' if not use_full else ''}_throughput",
+        "value": round(1.0 / pipe_s, 3),
+        "unit": "inferences/sec",
+        "vs_baseline": round(base_s / pipe_s, 4),
+        "stages": len(stages),
+        "single_device_s": round(base_s, 6),
+        "stage_latency_ms": m["stage_latency_ms"],
+        "duty_cycle": m["duty_cycle"],
+        "pipeline_efficiency": m["pipeline_efficiency"],
+        "buffer_bytes_per_hop": m["buffer_bytes_per_hop"],
+    }
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default=",".join(CONFIGS))
+    ap.add_argument("--tiny", action="store_true",
+                    help="force tiny variants (CPU smoke)")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--no-stage-latency", action="store_true")
+    args = ap.parse_args()
+
+    for name in args.configs.split(","):
+        name = name.strip()
+        if name not in CONFIGS:
+            log(f"unknown config {name!r}; have {list(CONFIGS)}")
+            continue
+        t0 = time.time()
+        try:
+            r = run_config(name, tiny=args.tiny, chunk=args.chunk,
+                           stage_lat=not args.no_stage_latency)
+        except Exception as e:  # noqa: BLE001 — keep the suite going
+            log(f"{name}: FAILED {type(e).__name__}: {e}")
+            continue
+        log(f"{name}: {r['value']} inf/s ({time.time() - t0:.0f}s)")
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

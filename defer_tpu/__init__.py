@@ -27,6 +27,10 @@ from .codec import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
 from .parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
 from .parallel.ring_attention import (SEQ_AXIS, ring_attention,
                                       sequence_parallel_attention)
+from .parallel.distributed import (initialize, multihost_pipeline_mesh,
+                                   process_local_batch)
+from .parallel.expert import (EXPERT_AXIS, expert_parallel_fn,
+                              expert_parallel_mesh, shard_moe_params)
 from .parallel.tensor import (MODEL_AXIS, shard_tp_params,
                               tensor_parallel_fn, tensor_parallel_mesh)
 from .partition.partitioner import partition
@@ -52,6 +56,9 @@ __all__ = [
     "flash_attention",
     "MODEL_AXIS", "shard_tp_params", "tensor_parallel_fn",
     "tensor_parallel_mesh",
+    "EXPERT_AXIS", "expert_parallel_fn", "expert_parallel_mesh",
+    "shard_moe_params",
+    "initialize", "multihost_pipeline_mesh", "process_local_batch",
     "Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec", "RawCodec",
     "save_params", "load_params", "profile_pipeline", "trace",
 ]

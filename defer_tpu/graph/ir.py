@@ -171,6 +171,8 @@ class LayerGraph:
         upto: str | None = None,
         start: str | None = None,
         node_names: Sequence[str] | None = None,
+        tp_axis: str | None = None,
+        tp: int = 1,
     ) -> jax.Array:
         """Memoized forward pass over (a sub-range of) the graph.
 
@@ -179,6 +181,10 @@ class LayerGraph:
         ``node_names`` are evaluated.  This is the functional equivalent of
         the reference's ``construct_model(model, start, end)``
         (src/dag_util.py:27-31) without rebuilding any graph.
+
+        With ``tp_axis`` set (inside ``shard_map`` over a "model" mesh
+        axis), each op runs its tensor-parallel path on TP-sharded params
+        (see ``parallel/tensor.py``).
         """
         start = start or self.input_name
         upto = upto or self.output_name
@@ -189,7 +195,11 @@ class LayerGraph:
                 continue
             node = self.nodes[name]
             xs = [cache[i] for i in node.inputs]
-            cache[name] = node.op.apply(params.get(name), *xs)
+            if tp_axis is not None and tp > 1:
+                cache[name] = node.op.tp_apply(params.get(name), *xs,
+                                               axis_name=tp_axis, tp=tp)
+            else:
+                cache[name] = node.op.apply(params.get(name), *xs)
             if name == upto:
                 break
         return cache[upto]

@@ -18,22 +18,28 @@ from jax.sharding import Mesh
 
 STAGE_AXIS = "stage"
 DATA_AXIS = "data"
+MODEL_AXIS = "model"
 
 
 def pipeline_mesh(num_stages: int, data_parallel: int = 1,
-                  devices=None) -> Mesh:
-    """Mesh of shape (data_parallel, num_stages) over the available devices.
+                  tensor_parallel: int = 1, devices=None) -> Mesh:
+    """Mesh of shape (data, stage[, model]) over the available devices.
 
-    Stage neighbors are placed adjacently so the stage-axis ``ppermute``
-    rides nearest-neighbor ICI links.
+    The model (tensor-parallel) axis is innermost — a stage's TP group sits
+    on adjacent devices so its per-layer psums ride nearest-neighbor ICI;
+    stage neighbors come next for the stage-axis ``ppermute``.
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = num_stages * data_parallel
+    need = num_stages * data_parallel * tensor_parallel
     if len(devices) < need:
         raise ValueError(
             f"pipeline needs {need} devices "
-            f"({data_parallel} data x {num_stages} stages) but only "
-            f"{len(devices)} available")
+            f"({data_parallel} data x {num_stages} stages x "
+            f"{tensor_parallel} model) but only {len(devices)} available")
+    if tensor_parallel > 1:
+        arr = np.array(devices[:need]).reshape(
+            data_parallel, num_stages, tensor_parallel)
+        return Mesh(arr, (DATA_AXIS, STAGE_AXIS, MODEL_AXIS))
     arr = np.array(devices[:need]).reshape(data_parallel, num_stages)
     return Mesh(arr, (DATA_AXIS, STAGE_AXIS))
 

@@ -41,8 +41,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graph.ir import LayerGraph
-
-MODEL_AXIS = "model"
+from .mesh import MODEL_AXIS
 
 
 def tensor_parallel_mesh(tp: int, devices=None) -> Mesh:

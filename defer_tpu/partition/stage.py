@@ -29,15 +29,24 @@ class StageSpec:
     in_spec: ShapeSpec
     out_spec: ShapeSpec
 
-    def fn(self, stage_params: dict[str, Any], x: jax.Array) -> jax.Array:
-        """Pure batched forward for this stage."""
+    def fn(self, stage_params: dict[str, Any], x: jax.Array, *,
+           tp_axis: str | None = None, tp: int = 1) -> jax.Array:
+        """Pure batched forward for this stage (optionally TP-sharded)."""
         return self.graph.apply(stage_params, x, start=self.input_name,
                                 upto=self.output_name,
-                                node_names=self.node_names)
+                                node_names=self.node_names,
+                                tp_axis=tp_axis, tp=tp)
 
     def select_params(self, params: dict[str, Any]) -> dict[str, Any]:
         """Subset of the full parameter pytree owned by this stage."""
         return {n: params[n] for n in self.node_names if n in params}
+
+    def tp_shard_params(self, params: dict[str, Any], tp: int,
+                        rank: int) -> dict[str, Any]:
+        """Rank ``rank``'s TP shard of this stage's parameters."""
+        sp = self.select_params(params)
+        return {n: self.graph.nodes[n].op.tp_shard(sp[n], tp, rank)
+                for n in sp}
 
     def __repr__(self):
         return (f"StageSpec({self.index}: {self.input_name} -> "

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterable, Iterator
 
 import numpy as np
@@ -41,9 +42,20 @@ class DeferHandle:
         self._stop = stop_event
         #: exception that killed the serve thread, if any
         self.error: BaseException | None = None
+        #: monotonic time the serve thread entered its current device
+        #: dispatch, or None while idle (used by the watchdog)
+        self._busy_since: float | None = None
+        #: completed dispatches; the watchdog only arms after the first one
+        #: so jit compilation time is never mistaken for a hang
+        self._dispatches: int = 0
 
     def stop(self):
         self._stop.set()
+
+    @property
+    def healthy(self) -> bool:
+        """False once the serve thread died or was declared hung."""
+        return self.error is None
 
     def join(self, timeout: float | None = None):
         """Wait for the serve thread; re-raises any error it died with."""
@@ -85,13 +97,48 @@ class Defer:
                                 compute_dtype=cfg.compute_dtype)
         mesh = self.mesh
         if mesh is None:
-            mesh = pipeline_mesh(len(stages), cfg.data_parallel)
+            mesh = pipeline_mesh(len(stages), cfg.data_parallel,
+                                 cfg.tensor_parallel)
         return SpmdPipeline(
             stages, params, mesh=mesh,
             microbatch=cfg.microbatch, chunk=cfg.chunk,
             buffer_dtype=jnp.dtype(cfg.buffer_dtype),
             compute_dtype=cfg.compute_dtype,
         )
+
+    # -- health ------------------------------------------------------------
+
+    def health_check(self, graph, params, cut_points=None, num_stages=None):
+        """Compile-and-run probe of a deployment before serving traffic.
+
+        Builds the pipeline, pushes one all-bubble chunk through the
+        compiled program, and reports per-deployment status — the "health
+        check on stage program compilation" the reference lacks entirely
+        (SURVEY.md §5: a bad partition there only surfaces when a node
+        crashes mid-stream).  Raises nothing: failures come back in the
+        report so callers can decide.
+        """
+        report: dict[str, Any] = {"ok": False, "stages": None,
+                                  "mesh": None, "error": None}
+        try:
+            pipe = self.build(graph, params, cut_points, num_stages)
+            report["stages"] = len(pipe.stages)
+            if getattr(pipe, "mesh", None) is not None:
+                report["mesh"] = dict(pipe.mesh.shape)
+            if isinstance(pipe, MpmdPipeline):
+                x = np.zeros((1, pipe.microbatch) + pipe.in_spec.shape,
+                             np.float32)
+                pipe.run(x)
+            else:
+                pipe.reset()
+                zeros = np.zeros((1, pipe.microbatch) + pipe.in_spec.shape,
+                                 np.float32)
+                pipe.push(zeros, n_real=0)
+                pipe.reset()
+            report["ok"] = True
+        except Exception as e:  # noqa: BLE001 — report, don't raise
+            report["error"] = e
+        return report
 
     # -- batch API ---------------------------------------------------------
 
@@ -142,6 +189,17 @@ class Defer:
                 handle.error = e        # dead thread + forever-blocked reader
                 output_stream.put(END_OF_STREAM)
 
+        def _dispatch(fn, *a, **kw):
+            # bracket device work so the watchdog can tell "waiting for
+            # input" (fine) from "stuck in a dispatch" (dead pipeline)
+            handle._busy_since = time.monotonic()
+            try:
+                out = fn(*a, **kw)
+            finally:
+                handle._busy_since = None
+            handle._dispatches += 1
+            return out
+
         def _serve_inner():
             if isinstance(pipe, MpmdPipeline):
                 while not stop.is_set():
@@ -151,7 +209,8 @@ class Defer:
                         continue
                     if x is END_OF_STREAM:
                         break
-                    output_stream.put(pipe.run(np.asarray(x)[None])[0])
+                    output_stream.put(
+                        _dispatch(pipe.run, np.asarray(x)[None])[0])
                 return
 
             pipe.reset()
@@ -177,14 +236,36 @@ class Defer:
                     batch.append(nxt)
                 n_real = len(batch)
                 pad = [np.zeros_like(batch[0])] * (pipe.chunk - n_real)
-                outs = pipe.push(np.stack(batch + pad), n_real=n_real)
+                outs = _dispatch(pipe.push, np.stack(batch + pad),
+                                 n_real=n_real)
                 for o in outs:
                     output_stream.put(np.asarray(o, np.float32))
-            for o in pipe.flush():
+            for o in _dispatch(pipe.flush):
                 output_stream.put(np.asarray(o, np.float32))
 
         thread = threading.Thread(target=serve, daemon=True,
                                   name="defer-dispatcher")
         handle = DeferHandle(thread, pipe, stop)
         thread.start()
+
+        if cfg.watchdog_s is not None:
+            def watch():
+                wd = cfg.watchdog_s
+                while not stop.is_set() and thread.is_alive():
+                    busy = handle._busy_since
+                    # unarmed until one dispatch completed: the first call
+                    # legitimately blocks for the whole jit compile
+                    if (handle._dispatches > 0 and busy is not None
+                            and time.monotonic() - busy > wd):
+                        # a dead device/backend: surface instead of the
+                        # reference's forever-hang (SURVEY.md §5 failure row)
+                        handle.error = TimeoutError(
+                            f"pipeline dispatch made no progress for "
+                            f"{wd:.1f}s; deployment declared dead")
+                        output_stream.put(END_OF_STREAM)
+                        return
+                    time.sleep(min(0.25, wd / 4))
+
+            threading.Thread(target=watch, daemon=True,
+                             name="defer-watchdog").start()
         return handle

@@ -44,7 +44,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graph.ir import ShapeSpec
-from ..parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS, pipeline_mesh
 from ..partition.stage import StageSpec
 from ..utils.metrics import PipelineMetrics
 
@@ -80,6 +80,7 @@ class SpmdPipeline:
                 f"mesh stage axis is {self.mesh.shape[STAGE_AXIS]} but "
                 f"pipeline has {n} stages")
         self.data_parallel = self.mesh.shape.get(DATA_AXIS, 1)
+        self.tensor_parallel = tp = self.mesh.shape.get(MODEL_AXIS, 1)
         if microbatch % self.data_parallel:
             raise ValueError("microbatch must divide by data_parallel")
         self.microbatch = microbatch
@@ -87,31 +88,46 @@ class SpmdPipeline:
         self.buffer_dtype = jnp.dtype(buffer_dtype)
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
 
-        # --- weights: one flat f32 vector per stage, padded & stacked to
-        # [N, Pmax], sharded over the stage axis.  Each device materializes
-        # only its own stage's parameters.
+        # --- weights: one flat f32 vector per stage (per TP rank when the
+        # mesh has a "model" axis), padded & stacked to [N, (tp,) Pmax] and
+        # sharded over (stage[, model]).  Each device materializes only its
+        # own stage's — and, under TP, its own rank's — parameters.
         self._wmeta: list[list[tuple[int, int, tuple[int, ...], Any]]] = []
         self._wtreedef = []
-        flats = []
+        flats: list[list[np.ndarray]] = []  # [stage][tp_rank]
         for s in self.stages:
-            leaves, treedef = jax.tree.flatten(s.select_params(params))
-            meta, off = [], 0
-            for leaf in leaves:
-                leaf = np.asarray(leaf)
-                meta.append((off, leaf.size, leaf.shape, leaf.dtype))
-                off += leaf.size
-            self._wmeta.append(meta)
-            self._wtreedef.append(treedef)
-            flats.append(
-                np.concatenate([np.asarray(l).ravel().astype(np.float32)
-                                for l in leaves])
-                if leaves else np.zeros((0,), np.float32))
-        pmax = max(max((f.size for f in flats), default=1), 1)
-        wbuf = np.zeros((n, pmax), np.float32)
-        for i, f in enumerate(flats):
-            wbuf[i, : f.size] = f
-        self._w = jax.device_put(
-            wbuf, NamedSharding(self.mesh, P(STAGE_AXIS, None)))
+            rank_flats = []
+            for r in range(tp):
+                shard = (s.tp_shard_params(params, tp, r) if tp > 1
+                         else s.select_params(params))
+                leaves, treedef = jax.tree.flatten(shard)
+                if r == 0:
+                    meta, off = [], 0
+                    for leaf in leaves:
+                        leaf = np.asarray(leaf)
+                        meta.append((off, leaf.size, leaf.shape, leaf.dtype))
+                        off += leaf.size
+                    self._wmeta.append(meta)
+                    self._wtreedef.append(treedef)
+                rank_flats.append(
+                    np.concatenate([np.asarray(l).ravel().astype(np.float32)
+                                    for l in leaves])
+                    if leaves else np.zeros((0,), np.float32))
+            flats.append(rank_flats)
+        pmax = max(max((f.size for rf in flats for f in rf), default=1), 1)
+        if tp > 1:
+            wbuf = np.zeros((n, tp, pmax), np.float32)
+            for i, rf in enumerate(flats):
+                for r, f in enumerate(rf):
+                    wbuf[i, r, : f.size] = f
+            wspec = P(STAGE_AXIS, MODEL_AXIS, None)
+        else:
+            wbuf = np.zeros((n, pmax), np.float32)
+            for i, rf in enumerate(flats):
+                wbuf[i, : rf[0].size] = rf[0]
+            wspec = P(STAGE_AXIS, None)
+        self._wspec = wspec
+        self._w = jax.device_put(wbuf, NamedSharding(self.mesh, wspec))
 
         # --- homogeneous activation buffer sizing
         self._in_sizes = [s.in_spec.size for s in self.stages]
@@ -158,6 +174,8 @@ class SpmdPipeline:
         x_dtype = (cd if cd is not None and jnp.issubdtype(in_dtype, jnp.floating)
                    else in_dtype)
 
+        tp = self.tensor_parallel
+
         def branch(w_local, a_local):
             leaves = [
                 lax.slice(w_local, (off,), (off + size,))
@@ -167,7 +185,7 @@ class SpmdPipeline:
             p = jax.tree.unflatten(treedef, leaves)
             b = a_local.shape[0]
             x = a_local[:, :in_sz].reshape((b,) + in_shape).astype(x_dtype)
-            y = stage.fn(p, x)
+            y = stage.fn(p, x, tp_axis=MODEL_AXIS if tp > 1 else None, tp=tp)
             y = y.reshape(b, out_sz).astype(self.buffer_dtype)
             if pad:
                 y = jnp.pad(y, ((0, 0), (0, pad)))
@@ -180,10 +198,12 @@ class SpmdPipeline:
         perm = [(k, (k + 1) % n) for k in range(n)]
         branches = self._branches
         has_dp = self.data_parallel > 1
+        has_tp = self.tensor_parallel > 1
 
         def device_chunk(w, a0, xs):
-            # local shapes: w [1, Pmax], a0 [1, Blocal, L], xs [T, Blocal, L]
-            w_l = w[0]
+            # local shapes: w [1, (1,) Pmax], a0 [1, Blocal, L],
+            # xs [T, Blocal, L]
+            w_l = w[0, 0] if has_tp else w[0]
             idx = lax.axis_index(STAGE_AXIS)
 
             def body(a, x):
@@ -206,7 +226,7 @@ class SpmdPipeline:
 
         fn = jax.shard_map(
             device_chunk, mesh=self.mesh,
-            in_specs=(P(STAGE_AXIS, None), bspec, xspec),
+            in_specs=(self._wspec, bspec, xspec),
             out_specs=(bspec, ospec),
             check_vma=False,
         )

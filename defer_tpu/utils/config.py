@@ -27,9 +27,18 @@ class DeferConfig:
     compute_dtype: str | None = None
     # extra batch-parallel pipeline replicas (mesh "data" axis)
     data_parallel: int = 1
+    # intra-stage Megatron-style weight sharding (mesh "model" axis);
+    # requires every parametric op in the model to implement TP hooks
+    tensor_parallel: int = 1
     # "spmd" (shard_map + ppermute, primary) or "mpmd" (per-stage programs +
     # device_put relay, correctness oracle / debug)
     mode: str = "spmd"
     # seconds the dispatcher waits for more queue items before padding a
     # partial chunk with bubbles
     gather_timeout_s: float = 0.002
+    # failure detection: once past the first (compile) dispatch, if a
+    # pipeline dispatch makes no progress for this many seconds the serve
+    # thread is declared dead and readers unblocked (the reference has no
+    # failure handling at all — a dead node hangs the chain forever,
+    # SURVEY.md §5; None disables)
+    watchdog_s: float | None = None

@@ -36,6 +36,25 @@ class PipelineMetrics:
         useful_steps = self.inferences / max(self.microbatch, 1)
         return max(0.0, 1.0 - useful_steps / self.steps)
 
+    @property
+    def duty_cycle(self) -> list[float]:
+        """Per-stage busy fraction at steady state (stage latency / slowest
+        stage).  The energy-analogue metric: the reference's headline -63%
+        per-node energy (README.md:12) comes from each node idling between
+        relays; duty cycle is the device-side measure of that idling."""
+        if not self.stage_latency_s:
+            return []
+        slowest = max(self.stage_latency_s)
+        if slowest <= 0:
+            return [0.0] * len(self.stage_latency_s)
+        return [l / slowest for l in self.stage_latency_s]
+
+    @property
+    def pipeline_efficiency(self) -> float:
+        """Mean duty cycle — 1.0 means perfectly balanced stages."""
+        d = self.duty_cycle
+        return sum(d) / len(d) if d else 0.0
+
     def as_dict(self) -> dict:
         return {
             "num_stages": self.num_stages,
@@ -46,6 +65,8 @@ class PipelineMetrics:
             "stage_latency_ms": [round(s * 1e3, 4) for s in self.stage_latency_s],
             "buffer_bytes_per_hop": self.buffer_bytes_per_hop,
             "bubble_fraction": round(self.bubble_fraction, 4),
+            "duty_cycle": [round(d, 4) for d in self.duty_cycle],
+            "pipeline_efficiency": round(self.pipeline_efficiency, 4),
         }
 
 

@@ -1,0 +1,35 @@
+"""Multi-host helpers (single-host degradation paths).
+
+Real DCN behavior needs multiple hosts; these tests pin the single-host
+contracts: initialize() is a safe no-op, the global mesh covers all local
+devices with the documented axis order, and per-host batch sharding
+validates divisibility.
+"""
+
+import pytest
+
+import jax
+
+from defer_tpu import (initialize, multihost_pipeline_mesh,
+                       process_local_batch)
+
+
+def test_initialize_single_host_noop():
+    initialize()  # must not raise or hang on one host
+    assert jax.process_count() == 1
+
+
+def test_multihost_mesh_axes():
+    mesh = multihost_pipeline_mesh(4, data_parallel=2)
+    assert mesh.shape == {"data": 2, "stage": 4}
+    mesh3 = multihost_pipeline_mesh(2, data_parallel=2, tensor_parallel=2)
+    assert mesh3.shape == {"data": 2, "stage": 2, "model": 2}
+
+
+def test_multihost_mesh_too_big_raises():
+    with pytest.raises(ValueError, match="available"):
+        multihost_pipeline_mesh(64, data_parallel=64)
+
+
+def test_process_local_batch():
+    assert process_local_batch(32) == 32  # one host owns everything

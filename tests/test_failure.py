@@ -1,0 +1,73 @@
+"""Failure detection: health checks, error propagation, watchdog.
+
+The reference's only failure behavior is a forever-hang (SURVEY.md §5: no
+retry, no health check; a dead node stalls the chain).  These tests pin the
+opposite contract: failures surface as errors, readers are unblocked, and a
+deployment can be probed before serving.
+"""
+
+import queue
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import Defer, DeferConfig, END_OF_STREAM
+from defer_tpu.models import resnet_tiny
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    g = resnet_tiny()
+    return g, g.init(jax.random.key(0))
+
+
+def test_health_check_ok(tiny):
+    g, p = tiny
+    rep = Defer(config=DeferConfig(microbatch=1, chunk=2)).health_check(
+        g, p, num_stages=4)
+    assert rep["ok"] and rep["stages"] == 4
+    assert rep["mesh"] == {"data": 1, "stage": 4}
+    assert rep["error"] is None
+
+
+def test_health_check_reports_failure(tiny):
+    g, _ = tiny
+    # missing parameters: every stage program fails at trace time — the
+    # "bad deployment caught before serving" case
+    rep = Defer(config=DeferConfig(microbatch=1, chunk=2)).health_check(
+        g, {}, num_stages=1)
+    assert not rep["ok"]
+    assert rep["error"] is not None
+
+
+def test_run_defer_propagates_stage_error(tiny):
+    g, p = tiny
+    in_q, out_q = queue.Queue(), queue.Queue()
+    h = Defer(config=DeferConfig(microbatch=1, chunk=2)).run_defer(
+        g, p, None, in_q, out_q, num_stages=2)
+    # wrong input shape: the dispatch raises inside the serve thread
+    in_q.put(np.zeros((1, 7), np.float32))
+    # reader is unblocked by the sentinel instead of hanging forever
+    assert out_q.get(timeout=120) is END_OF_STREAM
+    assert not h.healthy
+    with pytest.raises(RuntimeError, match="dispatcher thread failed"):
+        h.join(timeout=60)
+
+
+def test_watchdog_declares_hung_dispatch(tiny, monkeypatch):
+    g, p = tiny
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2,
+                                     watchdog_s=0.5))
+    in_q, out_q = queue.Queue(), queue.Queue()
+    h = defer.run_defer(g, p, None, in_q, out_q, num_stages=2)
+    # simulate a wedged device dispatch (e.g. a dead TPU tunnel) AFTER the
+    # compile warmup: the serve thread reports busy and never finishes
+    h._dispatches = 1
+    h._busy_since = time.monotonic() - 10.0
+    assert out_q.get(timeout=30) is END_OF_STREAM
+    assert isinstance(h.error, TimeoutError)
+    assert not h.healthy
+    h.stop()

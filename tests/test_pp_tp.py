@@ -1,0 +1,65 @@
+"""Composed pipeline x tensor parallelism: an SPMD pipeline whose stages
+are themselves Megatron-sharded over the "model" mesh axis must reproduce
+the single-device forward — the 3D (data x stage x model) extension of the
+partition-equivalence invariant.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from defer_tpu import (Defer, DeferConfig, SpmdPipeline, partition,
+                       pipeline_mesh)
+from defer_tpu.models import bert_tiny
+
+
+@pytest.fixture(scope="module")
+def bert():
+    graph = bert_tiny()
+    params = graph.init(jax.random.key(0))
+    ids = (np.arange(3 * 2 * 16).reshape(3, 2, 16) % 100).astype(np.int32)
+    ref = np.stack([np.asarray(graph.apply(params, jnp.asarray(b)))
+                    for b in ids])
+    return graph, params, ids, ref
+
+
+def test_pp_tp_matches_full(bert):
+    graph, params, ids, ref = bert
+    stages = partition(graph, num_stages=2)
+    mesh = pipeline_mesh(2, tensor_parallel=2)
+    assert mesh.shape == {"data": 1, "stage": 2, "model": 2}
+    pipe = SpmdPipeline(stages, params, mesh=mesh, microbatch=2, chunk=3)
+    out = pipe.run(ids.astype(np.float32))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pp_tp_dp_matches_full(bert):
+    graph, params, ids, ref = bert
+    stages = partition(graph, num_stages=2)
+    mesh = pipeline_mesh(2, data_parallel=2, tensor_parallel=2)
+    assert mesh.devices.size == 8
+    pipe = SpmdPipeline(stages, params, mesh=mesh, microbatch=2, chunk=3)
+    out = pipe.run(ids.astype(np.float32))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_defer_api_tensor_parallel(bert):
+    graph, params, ids, ref = bert
+    defer = Defer(config=DeferConfig(microbatch=2, chunk=3,
+                                     tensor_parallel=2))
+    out = defer.run(graph, params, ids.astype(np.float32), num_stages=4)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_tp_weight_buffer_is_sharded(bert):
+    """Under TP the flat weight buffer carries per-rank shards: its Pmax is
+    about half the tp=1 Pmax for bert_tiny (qkv/fc dominate)."""
+    graph, params, _, _ = bert
+    stages = partition(graph, num_stages=2)
+    p1 = SpmdPipeline(stages, params, mesh=pipeline_mesh(2), microbatch=2)
+    p2 = SpmdPipeline(stages, params, mesh=pipeline_mesh(
+        2, tensor_parallel=2), microbatch=2)
+    assert p2._w.shape[0] == 2 and p2._w.ndim == 3
+    assert p2._w.shape[-1] < p1._w.shape[-1]
