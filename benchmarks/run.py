@@ -114,7 +114,7 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool):
         chunk=chunk,
         buffer_dtype=jnp.bfloat16 if on_tpu and kind == "f" else jnp.float32,
         compute_dtype=compute_dtype)
-    xs = sample(in_shape, kind, 1, lead=(chunk,))
+    xs = pipe.stage_inputs(sample(in_shape, kind, 1, lead=(chunk,)))
 
     def push_chunk():
         pipe.push(xs, n_real=chunk)

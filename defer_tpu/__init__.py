@@ -27,6 +27,8 @@ from .codec import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
 from .parallel.mesh import DATA_AXIS, STAGE_AXIS, pipeline_mesh
 from .parallel.ring_attention import (SEQ_AXIS, ring_attention,
                                       sequence_parallel_attention)
+from .parallel.ulysses import (sequence_parallel_attention_ulysses,
+                               ulysses_attention)
 from .parallel.distributed import (initialize, multihost_pipeline_mesh,
                                    process_local_batch)
 from .parallel.expert import (EXPERT_AXIS, expert_parallel_fn,
@@ -53,6 +55,7 @@ __all__ = [
     "SpmdPipeline", "MpmdPipeline", "Defer", "DeferHandle", "DeferConfig",
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
     "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",
+    "sequence_parallel_attention_ulysses", "ulysses_attention",
     "flash_attention",
     "MODEL_AXIS", "shard_tp_params", "tensor_parallel_fn",
     "tensor_parallel_mesh",

@@ -1,0 +1,43 @@
+"""Mixture-of-experts transformer family (6th model family, beyond the
+five BASELINE.md configs).
+
+Alternates dense attention blocks (``TransformerBlock``) with switch-MoE
+FFN layers (``ops.MoE``); every block output is a single-tensor cut point,
+so the family pipelines exactly like BERT.  Inside a pipeline stage the MoE
+op runs its dense (evaluate-all-experts, mask) form; the expert-parallel
+all_to_all execution over an "expert" mesh axis is available standalone via
+:mod:`defer_tpu.parallel.expert`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..graph.ir import GraphBuilder, LayerGraph
+from ..graph.ops import LayerNorm, MoE, TransformerBlock
+from .bert import BertEmbedding, Pooler
+
+
+def moe_transformer(num_layers: int, hidden: int, heads: int,
+                    num_experts: int, expert_hidden: int, seq_len: int,
+                    vocab: int = 30522,
+                    name: str = "moe_transformer") -> LayerGraph:
+    b = GraphBuilder(name)
+    x = b.input((seq_len,), jnp.int32)
+    x = b.add(BertEmbedding(vocab, hidden, seq_len), x, name="embeddings")
+    for i in range(num_layers):
+        x = b.add(TransformerBlock(heads), x, name=f"block_{i}")
+        x = b.add(MoE(num_experts, expert_hidden), x, name=f"moe_{i}")
+    x = b.add(LayerNorm(), x, name="final_ln")
+    x = b.add(Pooler(hidden), x, name="pooler")
+    return b.build()
+
+
+def moe_tiny(seq_len: int = 16) -> LayerGraph:
+    return moe_transformer(2, 32, 2, 4, 64, seq_len, vocab=100,
+                           name="moe_tiny")
+
+
+#: one (attention block + MoE) pair per stage
+def moe_stage_cuts(num_layers: int) -> list[str]:
+    return [f"moe_{i}" for i in range(num_layers - 1)]

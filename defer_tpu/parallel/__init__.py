@@ -7,3 +7,5 @@ from .expert import (EXPERT_AXIS, expert_parallel_fn, expert_parallel_mesh,
                      shard_moe_params)
 from .tensor import (MODEL_AXIS, shard_tp_params, tensor_parallel_fn,
                      tensor_parallel_mesh)
+from .ulysses import (sequence_parallel_attention_ulysses,
+                      ulysses_attention)

@@ -43,11 +43,12 @@ def initialize(coordinator_address: str | None = None,
     # impossible ("must be called before any JAX computations").
     if coordinator_address is None and num_processes is None:
         # env-autoconfigured (TPU pod metadata, SLURM, ...) or single-host;
-        # autoconfig raises on a plain single host -> graceful no-op
+        # autoconfig raises on a plain single host -> graceful no-op.
+        # Deliberately NOT latched: a later call with explicit coordinator
+        # args must still be able to form the cluster.
         try:
             jax.distributed.initialize()
         except (ValueError, RuntimeError):
-            _initialized = True
             return
     else:
         jax.distributed.initialize(

@@ -209,8 +209,10 @@ class Defer:
                         continue
                     if x is END_OF_STREAM:
                         break
-                    output_stream.put(
-                        _dispatch(pipe.run, np.asarray(x)[None])[0])
+                    y = _dispatch(pipe.run, np.asarray(x)[None])[0]
+                    if handle.error is not None:
+                        return  # watchdog fired mid-dispatch
+                    output_stream.put(y)
                 return
 
             pipe.reset()
@@ -238,8 +240,12 @@ class Defer:
                 pad = [np.zeros_like(batch[0])] * (pipe.chunk - n_real)
                 outs = _dispatch(pipe.push, np.stack(batch + pad),
                                  n_real=n_real)
+                if handle.error is not None:
+                    return  # watchdog fired mid-dispatch; sentinel is out
                 for o in outs:
                     output_stream.put(np.asarray(o, np.float32))
+            if handle.error is not None:
+                return
             for o in _dispatch(pipe.flush):
                 output_stream.put(np.asarray(o, np.float32))
 
@@ -262,7 +268,8 @@ class Defer:
                         handle.error = TimeoutError(
                             f"pipeline dispatch made no progress for "
                             f"{wd:.1f}s; deployment declared dead")
-                        output_stream.put(END_OF_STREAM)
+                        stop.set()  # serve loop exits; no outputs after the
+                        output_stream.put(END_OF_STREAM)  # sentinel below
                         return
                     time.sleep(min(0.25, wd / 4))
 

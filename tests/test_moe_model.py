@@ -1,0 +1,27 @@
+"""MoE transformer family: pipeline partition equivalence + cut points."""
+
+import numpy as np
+
+import jax
+
+from defer_tpu import Defer, DeferConfig, partition, valid_cut_points
+from defer_tpu.models import moe_stage_cuts, moe_tiny
+
+
+def test_moe_pipeline_matches_full():
+    g = moe_tiny()
+    p = g.init(jax.random.key(0))
+    ids = (np.arange(3 * 1 * 16).reshape(3, 1, 16) % 100)
+    ref = np.stack([np.asarray(g.apply(p, i)) for i in ids])
+    out = Defer(config=DeferConfig(microbatch=1, chunk=3)).run(
+        g, p, ids.astype(np.float32), cut_points=moe_stage_cuts(2))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_cut_points_are_articulation():
+    g = moe_tiny()
+    cuts = set(valid_cut_points(g))
+    for c in moe_stage_cuts(2):
+        assert c in cuts
+    stages = partition(g, moe_stage_cuts(2))
+    assert len(stages) == 2

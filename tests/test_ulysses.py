@@ -1,0 +1,46 @@
+"""Ulysses (all_to_all) sequence parallelism == full attention == ring."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from defer_tpu.parallel.ring_attention import (full_attention,
+                                               sequence_parallel_attention)
+from defer_tpu.parallel.ulysses import sequence_parallel_attention_ulysses
+
+
+def qkv(b=1, h=8, t=32, d=8, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, h, t, d)) for k in ks)
+
+
+def seq_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_ulysses_matches_full(n, causal):
+    q, k, v = qkv()
+    ref = full_attention(q, k, v, causal=causal)
+    out = sequence_parallel_attention_ulysses(q, k, v, seq_mesh(n),
+                                              causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_matches_ring():
+    q, k, v = qkv(seed=3)
+    mesh = seq_mesh(4)
+    a = sequence_parallel_attention_ulysses(q, k, v, mesh, causal=True)
+    b = sequence_parallel_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_head_divisibility():
+    q, k, v = qkv(h=6)
+    with pytest.raises(Exception, match="divisible"):
+        sequence_parallel_attention_ulysses(q, k, v, seq_mesh(4))
