@@ -41,6 +41,7 @@ from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
 from .runtime.mpmd import MpmdPipeline
 from .runtime.spmd import SpmdPipeline
 from .utils.checkpoint import load_params, save_params
+from .utils.export import export_pipeline, export_stage, load_stage
 from .utils.config import DeferConfig
 from .utils.metrics import PipelineMetrics, StopwatchWindow
 from .utils.profiling import profile_pipeline, trace
@@ -64,4 +65,5 @@ __all__ = [
     "initialize", "multihost_pipeline_mesh", "process_local_batch",
     "Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec", "RawCodec",
     "save_params", "load_params", "profile_pipeline", "trace",
+    "export_stage", "export_pipeline", "load_stage",
 ]

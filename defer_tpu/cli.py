@@ -1,0 +1,133 @@
+"""Command-line interface: ``python -m defer_tpu <command>``.
+
+The reference deploys by running standalone scripts on each machine
+(``python node.py`` per compute node + a driver for the dispatcher,
+reference src/node.py:126-127, test/test.py); the SPMD design needs no
+per-node processes, so the CLI's job is inspection and benchmarking of a
+deployment from one controller:
+
+  models     list the model zoo
+  partition  show the stage table for a model + cut spec (DOT optional)
+  bench      timed-window pipeline throughput vs single-device baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _get_model(name: str):
+    from . import models
+    if not hasattr(models, name):
+        raise SystemExit(
+            f"unknown model {name!r}; try: python -m defer_tpu models")
+    return getattr(models, name)()
+
+
+def cmd_models(_args):
+    from . import models
+    for n in models.__all__:
+        obj = getattr(models, n)
+        if callable(obj):
+            print(n)
+        else:
+            print(f"{n}  (cut list, {len(obj)} cuts)")
+
+
+def cmd_partition(args):
+    import jax
+
+    from . import partition, valid_cut_points
+    from .graph.viz import summary, to_dot
+
+    graph = _get_model(args.model)
+    cuts = args.cuts.split(",") if args.cuts else None
+    stages = partition(graph, cuts, num_stages=args.stages)
+    print(f"{graph.name}: {len(graph.nodes)} nodes, "
+          f"{len(valid_cut_points(graph))} valid cut points")
+    for s in stages:
+        print(f"  {s}")
+    if args.summary:
+        print(summary(graph))
+    if args.dot:
+        stage_of = {name: s.index for s in stages for name in s.node_names}
+        with open(args.dot, "w") as f:
+            f.write(to_dot(graph, stage_of=stage_of))
+        print(f"wrote {args.dot}")
+    del jax  # imported for backend side effects only
+
+
+def cmd_bench(args):
+    import jax
+    import jax.numpy as jnp
+
+    from . import SpmdPipeline, partition, pipeline_mesh
+
+    graph = _get_model(args.model)
+    params = graph.init(jax.random.key(0))
+    cuts = args.cuts.split(",") if args.cuts else None
+    stages = partition(graph, cuts, num_stages=args.stages)
+    n = len(stages)
+    pipe = SpmdPipeline(
+        stages, params, mesh=pipeline_mesh(n), microbatch=args.microbatch,
+        chunk=args.chunk, wire=args.wire,
+        buffer_dtype=jnp.bfloat16
+        if jax.default_backend() == "tpu" else jnp.float32)
+    in_spec = stages[0].in_spec
+    xs = pipe.stage_inputs(np.zeros(
+        (args.chunk, args.microbatch) + in_spec.shape, np.float32))
+
+    def step():
+        pipe.push(xs, n_real=args.chunk)
+        jax.block_until_ready(pipe._a)
+
+    step()  # compile
+    t0 = time.perf_counter()
+    iters = 0
+    while time.perf_counter() - t0 < args.seconds:
+        step()
+        iters += 1
+    dt = time.perf_counter() - t0
+    ips = iters * args.chunk * args.microbatch / dt
+    print(json.dumps({
+        "metric": f"{args.model}_{n}stage_throughput",
+        "value": round(ips, 3), "unit": "inferences/sec",
+        "wire": args.wire,
+        "devices": len(jax.devices()),
+        **pipe.metrics.as_dict()}))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m defer_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("models", help="list the model zoo")
+
+    p = sub.add_parser("partition", help="show the stage table")
+    p.add_argument("--model", required=True)
+    p.add_argument("--stages", type=int)
+    p.add_argument("--cuts")
+    p.add_argument("--dot", help="write a DOT graph with stage coloring")
+    p.add_argument("--summary", action="store_true")
+
+    b = sub.add_parser("bench", help="timed pipeline throughput")
+    b.add_argument("--model", default="resnet_tiny")
+    b.add_argument("--stages", type=int)
+    b.add_argument("--cuts")
+    b.add_argument("--chunk", type=int, default=16)
+    b.add_argument("--microbatch", type=int, default=1)
+    b.add_argument("--wire", default="buffer", choices=["buffer", "int8"])
+    b.add_argument("--seconds", type=float, default=5.0)
+
+    args = ap.parse_args(argv)
+    {"models": cmd_models, "partition": cmd_partition,
+     "bench": cmd_bench}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

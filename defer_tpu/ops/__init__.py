@@ -7,5 +7,8 @@ plain-XLA implementations as the fallback everywhere else.
 """
 
 from .flash_attention import flash_attention
+from .quant import (BLOCK as QUANT_BLOCK, dequantize_int8_blocks,
+                    quantize_int8_blocks)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "QUANT_BLOCK", "quantize_int8_blocks",
+           "dequantize_int8_blocks"]

@@ -1,0 +1,45 @@
+"""Device-side block-scale int8 quantization for inter-stage transfers.
+
+The TPU-idiomatic analogue of the reference's lossy ZFP activation
+compression (reference src/node.py:107, src/dispatcher.py:92): instead of
+CPU-side compression of the wire payload, activations are quantized to int8
+with one float32 scale per 256-value block *in HBM, inside the compiled
+program*, immediately before the stage-to-stage ``ppermute`` — ICI moves
+~1.016 bytes/value instead of 2 (bf16) or 4 (f32) — and dequantized right
+after.  Pure jnp; XLA fuses both sides into the neighboring stage programs.
+
+Relative error is <= 1/254 of each block's max |value| (symmetric int8),
+comparable to the default ZFP tolerance the reference ships.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: values per shared scale
+BLOCK = 256
+
+
+def quantize_int8_blocks(x: jnp.ndarray):
+    """[..., L] float -> ([..., L] int8, [..., L/BLOCK] f32 scales).
+
+    L must be a multiple of BLOCK (the pipeline pads its transfer buffer
+    up-front).  Non-finite inputs are flushed to 0 like the host codec.
+    """
+    *lead, n = x.shape
+    if n % BLOCK:
+        raise ValueError(f"last dim {n} not a multiple of {BLOCK}")
+    xb = x.reshape(*lead, n // BLOCK, BLOCK).astype(jnp.float32)
+    xb = jnp.where(jnp.isfinite(xb), xb, 0.0)
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xb / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q.reshape(*lead, n), scale
+
+
+def dequantize_int8_blocks(q: jnp.ndarray, scale: jnp.ndarray,
+                           dtype=jnp.float32):
+    """Inverse of :func:`quantize_int8_blocks`."""
+    *lead, n = q.shape
+    xb = q.reshape(*lead, n // BLOCK, BLOCK).astype(jnp.float32)
+    return (xb * scale[..., None]).reshape(*lead, n).astype(dtype)

@@ -104,6 +104,7 @@ class Defer:
             microbatch=cfg.microbatch, chunk=cfg.chunk,
             buffer_dtype=jnp.dtype(cfg.buffer_dtype),
             compute_dtype=cfg.compute_dtype,
+            wire=cfg.wire,
         )
 
     # -- health ------------------------------------------------------------

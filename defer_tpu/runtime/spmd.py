@@ -71,6 +71,7 @@ class SpmdPipeline:
         chunk: int = 16,
         buffer_dtype=jnp.float32,
         compute_dtype=None,
+        wire: str = "buffer",
     ):
         self.stages = list(stages)
         self.num_stages = n = len(self.stages)
@@ -130,9 +131,18 @@ class SpmdPipeline:
         self._w = jax.device_put(wbuf, NamedSharding(self.mesh, wspec))
 
         # --- homogeneous activation buffer sizing
+        if wire not in ("buffer", "int8"):
+            raise ValueError(f"wire must be 'buffer' or 'int8', got {wire!r}")
+        self.wire = wire
         self._in_sizes = [s.in_spec.size for s in self.stages]
         self._out_sizes = [s.out_spec.size for s in self.stages]
         self.buf_elems = max(self._in_sizes + self._out_sizes)
+        if wire == "int8":
+            # the stage->stage hop is block-quantized in HBM (the device-
+            # side analogue of the reference's ZFP wire compression);
+            # blocks share one scale, so pad the buffer to a block multiple
+            from ..ops.quant import BLOCK
+            self.buf_elems = -(-self.buf_elems // BLOCK) * BLOCK
         self.in_spec: ShapeSpec = self.stages[0].in_spec
         self.out_spec: ShapeSpec = self.stages[-1].out_spec
 
@@ -153,10 +163,17 @@ class SpmdPipeline:
                 "buffer_dtype=float32: ids above 256 are not exactly "
                 f"representable in {self.buffer_dtype.name}")
 
+        if wire == "int8":
+            from ..ops.quant import BLOCK
+            # int8 payload + one f32 scale per block
+            hop_bytes = self.microbatch * (
+                self.buf_elems + 4 * (self.buf_elems // BLOCK))
+        else:
+            hop_bytes = (self.buf_elems * self.microbatch
+                         * self.buffer_dtype.itemsize)
         self.metrics = PipelineMetrics(
             num_stages=n, microbatch=microbatch, buffer_elems=self.buf_elems,
-            buffer_bytes_per_hop=self.buf_elems * self.microbatch
-            * self.buffer_dtype.itemsize)
+            buffer_bytes_per_hop=hop_bytes)
         self._flush_zeros = None  # lazy device-resident bubble block
         self.reset()
 
@@ -201,6 +218,12 @@ class SpmdPipeline:
         has_dp = self.data_parallel > 1
         has_tp = self.tensor_parallel > 1
 
+        int8_wire = self.wire == "int8"
+        if int8_wire:
+            from ..ops.quant import (dequantize_int8_blocks,
+                                     quantize_int8_blocks)
+        buffer_dtype = self.buffer_dtype
+
         def device_chunk(w, a0, xs):
             # local shapes: w [1, (1,) Pmax], a0 [1, Blocal, L],
             # xs [T, Blocal, L]
@@ -213,7 +236,15 @@ class SpmdPipeline:
                 # relay to successor over ICI (src/node.py:103-108)
                 a = jnp.where(idx == 0, x, a)
                 y = lax.switch(idx, branches, w_l, a)
-                y_next = lax.ppermute(y, STAGE_AXIS, perm)
+                if int8_wire:
+                    # quantize the hop in HBM: ICI carries ~1 byte/value
+                    # (the ZFP-wire analogue, SURVEY.md §2.2)
+                    q, s = quantize_int8_blocks(y)
+                    q = lax.ppermute(q, STAGE_AXIS, perm)
+                    s = lax.ppermute(s, STAGE_AXIS, perm)
+                    y_next = dequantize_int8_blocks(q, s, buffer_dtype)
+                else:
+                    y_next = lax.ppermute(y, STAGE_AXIS, perm)
                 return y_next, y_next
 
             a_t, outs = lax.scan(body, a0[0], xs)

@@ -25,6 +25,10 @@ class DeferConfig:
     buffer_dtype: str = "float32"
     # dtype activations are cast to inside each stage (None = model dtype)
     compute_dtype: str | None = None
+    # stage->stage hop encoding: "buffer" sends the raw transfer buffer;
+    # "int8" block-quantizes the hop in HBM (ICI moves ~1 byte/value — the
+    # device-side analogue of the reference's ZFP wire compression)
+    wire: str = "buffer"
     # extra batch-parallel pipeline replicas (mesh "data" axis)
     data_parallel: int = 1
     # intra-stage Megatron-style weight sharding (mesh "model" axis);

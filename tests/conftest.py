@@ -23,6 +23,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (subprocess CLI, big configs)")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _devices():
     assert len(jax.devices()) == 8, jax.devices()
