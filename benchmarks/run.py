@@ -103,6 +103,11 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool):
     x1 = jnp.asarray(sample(in_shape, kind, 1))
     if kind == "i":
         x1 = x1.astype(jnp.int32)
+    elif compute_dtype is not None:
+        # baseline must compute in the same dtype as the pipeline: f32
+        # inputs would make every op cast params back up, timing an f32
+        # baseline against a bf16 pipeline (inflating vs_baseline)
+        x1 = x1.astype(compute_dtype)
     fwd = jax.jit(graph.apply)
     params_c = (jax.tree.map(lambda a: a.astype(compute_dtype), params)
                 if compute_dtype else params)

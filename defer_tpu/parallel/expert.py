@@ -87,7 +87,17 @@ def expert_parallel_apply(op: MoE, params_local, x, *, axis_name: str,
     slot = jnp.where(keep, pos, capacity)                # overflow -> C (cut)
 
     # payload = token features + its local expert index; the gate prob stays
-    # local (applied to the returned result), so it never rides the wire
+    # local (applied to the returned result), so it never rides the wire.
+    # The index rides in the activation dtype, so it must be exactly
+    # representable there: floats are integer-exact only up to
+    # 2**(mantissa+1) (bf16: 256, f16: 2048), beyond which routing would
+    # silently send tokens to the wrong local expert.
+    exact_max = 2 ** (jnp.finfo(xf.dtype).nmant + 1)
+    if el > exact_max:
+        raise ValueError(
+            f"{el} local experts per device cannot ride an {xf.dtype} "
+            f"all_to_all payload exactly (max {exact_max}); use wider "
+            f"activations or more expert-parallel ranks")
     lid = (eidf % el).astype(xf.dtype)
     payload = jnp.concatenate([xf, lid[:, None]], axis=-1)  # [n, d+1]
     buf = jnp.zeros((ep, capacity + 1, d + 1), xf.dtype)

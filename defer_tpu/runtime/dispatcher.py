@@ -58,8 +58,22 @@ class DeferHandle:
         return self.error is None
 
     def join(self, timeout: float | None = None):
-        """Wait for the serve thread; re-raises any error it died with."""
-        self._thread.join(timeout)
+        """Wait for the serve thread; re-raises any error it died with.
+
+        Raises as soon as ``error`` is set rather than waiting for thread
+        exit: when the watchdog declares the deployment dead, the serve
+        thread may be permanently wedged inside a device dispatch — exactly
+        the case where an unbounded ``Thread.join`` would never return.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.error is None and self._thread.is_alive():
+            step = 0.25
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                step = min(step, left)
+            self._thread.join(step)
         if self.error is not None:
             raise RuntimeError("defer dispatcher thread failed") from self.error
 
@@ -131,11 +145,11 @@ class Defer:
                              np.float32)
                 pipe.run(x)
             else:
-                pipe.reset()
-                zeros = np.zeros((1, pipe.microbatch) + pipe.in_spec.shape,
-                                 np.float32)
-                pipe.push(zeros, n_real=0)
-                pipe.reset()
+                # full-chunk bubble probe: the compiled artifact exercised
+                # here is the exact [chunk, ...] program that will serve
+                # traffic (a [1, ...] probe would compile a different
+                # program and miss chunk-shape-specific failures)
+                pipe.warmup()
             report["ok"] = True
         except Exception as e:  # noqa: BLE001 — report, don't raise
             report["error"] = e
@@ -190,10 +204,14 @@ class Defer:
                 handle.error = e        # dead thread + forever-blocked reader
                 output_stream.put(END_OF_STREAM)
 
-        def _dispatch(fn, *a, **kw):
+        def _dispatch(fn, *a, arm=True, **kw):
             # bracket device work so the watchdog can tell "waiting for
-            # input" (fine) from "stuck in a dispatch" (dead pipeline)
-            handle._busy_since = time.monotonic()
+            # input" (fine) from "stuck in a dispatch" (dead pipeline).
+            # arm=False exempts dispatches that may legitimately block for
+            # an XLA compile (new input shape in MPMD mode) — a compile is
+            # not a hang, however long it takes.
+            if arm:
+                handle._busy_since = time.monotonic()
             try:
                 out = fn(*a, **kw)
             finally:
@@ -203,6 +221,15 @@ class Defer:
 
         def _serve_inner():
             if isinstance(pipe, MpmdPipeline):
+                if cfg.preflight:
+                    # compile-and-run probe before serving traffic (the
+                    # reference has no health check at all: a bad partition
+                    # only surfaces when a node dies mid-stream, SURVEY.md §5)
+                    _dispatch(pipe.run, np.zeros(
+                        (1, pipe.microbatch) + pipe.in_spec.shape, np.float32))
+                    if handle.error is not None:
+                        return
+                seen_shapes: set[tuple] = set()
                 while not stop.is_set():
                     try:
                         x = input_stream.get(timeout=0.05)
@@ -210,13 +237,24 @@ class Defer:
                         continue
                     if x is END_OF_STREAM:
                         break
-                    y = _dispatch(pipe.run, np.asarray(x)[None])[0]
+                    xa = np.asarray(x)
+                    # a new shape means a fresh per-stage jit compile: don't
+                    # let the watchdog mistake compile time for a hang
+                    fresh = xa.shape not in seen_shapes
+                    seen_shapes.add(xa.shape)
+                    y = _dispatch(pipe.run, xa[None], arm=not fresh)[0]
                     if handle.error is not None:
                         return  # watchdog fired mid-dispatch
                     output_stream.put(y)
                 return
 
             pipe.reset()
+            if cfg.preflight:
+                # serve the first real input from an already-validated,
+                # already-compiled full-chunk program
+                _dispatch(pipe.warmup)
+                if handle.error is not None:
+                    return
             done = False
             while not done and not stop.is_set():
                 batch: list[np.ndarray] = []
@@ -247,7 +285,13 @@ class Defer:
                     output_stream.put(np.asarray(o, np.float32))
             if handle.error is not None:
                 return
-            for o in _dispatch(pipe.flush):
+            outs = _dispatch(pipe.flush)
+            if handle.error is not None:
+                # watchdog fired during the drain dispatch: the sentinel is
+                # already on the queue; emitting outputs after it would
+                # violate the stream protocol for readers
+                return
+            for o in outs:
                 output_stream.put(np.asarray(o, np.float32))
 
         thread = threading.Thread(target=serve, daemon=True,

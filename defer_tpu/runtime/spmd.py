@@ -89,10 +89,16 @@ class SpmdPipeline:
         self.buffer_dtype = jnp.dtype(buffer_dtype)
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
 
-        # --- weights: one flat f32 vector per stage (per TP rank when the
+        # --- weights: one flat vector per stage (per TP rank when the
         # mesh has a "model" axis), padded & stacked to [N, (tp,) Pmax] and
         # sharded over (stage[, model]).  Each device materializes only its
-        # own stage's — and, under TP, its own rank's — parameters.
+        # own stage's — and, under TP, its own rank's — parameters.  The
+        # buffer is stored in ``compute_dtype`` when set (bf16 deployments
+        # hold bf16 weights in HBM — half the footprint, no per-step
+        # recast inside the branch); float32 otherwise.
+        self.weight_dtype = wdt = np.dtype(
+            self.compute_dtype if self.compute_dtype is not None
+            else np.float32)
         self._wmeta: list[list[tuple[int, int, tuple[int, ...], Any]]] = []
         self._wtreedef = []
         flats: list[list[np.ndarray]] = []  # [stage][tp_rank]
@@ -111,19 +117,19 @@ class SpmdPipeline:
                     self._wmeta.append(meta)
                     self._wtreedef.append(treedef)
                 rank_flats.append(
-                    np.concatenate([np.asarray(l).ravel().astype(np.float32)
-                                    for l in leaves])
-                    if leaves else np.zeros((0,), np.float32))
+                    np.concatenate([self._to_wire(np.asarray(l), s.name)
+                                    .ravel() for l in leaves])
+                    if leaves else np.zeros((0,), wdt))
             flats.append(rank_flats)
         pmax = max(max((f.size for rf in flats for f in rf), default=1), 1)
         if tp > 1:
-            wbuf = np.zeros((n, tp, pmax), np.float32)
+            wbuf = np.zeros((n, tp, pmax), wdt)
             for i, rf in enumerate(flats):
                 for r, f in enumerate(rf):
                     wbuf[i, r, : f.size] = f
             wspec = P(STAGE_AXIS, MODEL_AXIS, None)
         else:
-            wbuf = np.zeros((n, pmax), np.float32)
+            wbuf = np.zeros((n, pmax), wdt)
             for i, rf in enumerate(flats):
                 wbuf[i, : rf[0].size] = rf[0]
             wspec = P(STAGE_AXIS, None)
@@ -181,6 +187,29 @@ class SpmdPipeline:
     # program construction
     # ------------------------------------------------------------------
 
+    def _to_wire(self, leaf: np.ndarray, stage_name: str) -> np.ndarray:
+        """Cast one param leaf into the flat weight buffer's dtype.
+
+        Float leaves simply cast (lossy to bf16 is the deployment's choice).
+        Integer/bool leaves are only accepted when they round-trip exactly
+        through the buffer dtype — the reference ships raw per-dtype arrays
+        (src/dispatcher.py:67-80) so it never has this hazard; the flat
+        homogeneous buffer does, and silently corrupted int params (e.g.
+        embedding ids) would be far worse than a loud error here.
+        """
+        wdt = self.weight_dtype
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(wdt)
+        cast = leaf.astype(wdt)
+        if not np.array_equal(cast.astype(leaf.dtype), leaf):
+            raise ValueError(
+                f"stage {stage_name!r} has a non-float param leaf "
+                f"(dtype {leaf.dtype}) whose values do not survive the "
+                f"{wdt} weight buffer; use compute_dtype=None (float32 "
+                f"buffer, exact for |int| < 2**24) or keep such leaves "
+                f"out of the flat buffer")
+        return cast
+
     def _make_branch(self, k: int):
         stage = self.stages[k]
         meta = self._wmeta[k]
@@ -194,10 +223,20 @@ class SpmdPipeline:
 
         tp = self.tensor_parallel
 
+        wdt = self.weight_dtype
+
+        def leaf_dtype(dtype):
+            # under compute_dtype, float leaves stay in the buffer's storage
+            # dtype (the stage computes in it anyway — no per-step recast);
+            # otherwise every leaf restores its exact original dtype
+            if cd is not None and jnp.issubdtype(dtype, jnp.floating):
+                return wdt
+            return dtype
+
         def branch(w_local, a_local):
             leaves = [
-                lax.slice(w_local, (off,), (off + size,))
-                .reshape(shape).astype(dtype)
+                lax.slice(w_local, (off,), (off + size,)).reshape(shape)
+                .astype(leaf_dtype(dtype))
                 for off, size, shape, dtype in meta
             ]
             p = jax.tree.unflatten(treedef, leaves)
@@ -223,6 +262,7 @@ class SpmdPipeline:
             from ..ops.quant import (dequantize_int8_blocks,
                                      quantize_int8_blocks)
         buffer_dtype = self.buffer_dtype
+        out_sz_last = self._out_sizes[-1]
 
         def device_chunk(w, a0, xs):
             # local shapes: w [1, (1,) Pmax], a0 [1, Blocal, L],
@@ -245,7 +285,13 @@ class SpmdPipeline:
                     y_next = dequantize_int8_blocks(q, s, buffer_dtype)
                 else:
                     y_next = lax.ppermute(y, STAGE_AXIS, perm)
-                return y_next, y_next
+                # per-step output: only the slice the dispatcher reads —
+                # what stage N-1 just delivered to device 0 (reference
+                # src/dispatcher.py:102-105).  Emitting the whole buffer
+                # here made XLA stack [T, B, buf_elems] per device (~100 MB
+                # of dead stores per ResNet50 chunk) when only device 0's
+                # first out_sz_last columns are ever read.
+                return y_next, lax.slice_in_dim(y_next, 0, out_sz_last, axis=1)
 
             a_t, outs = lax.scan(body, a0[0], xs)
             return a_t[None], outs[None]
@@ -330,10 +376,10 @@ class SpmdPipeline:
     def _collect(self, outs, c: int):
         """Map step outputs back to microbatch indices and drop bubbles."""
         n = self.num_stages
-        out_sz = self._out_sizes[-1]
         out_shape = (self.microbatch,) + self.out_spec.shape
-        # outs[0] is device-0's [T, B, L] slice: what arrived at "the
-        # dispatcher" each step (reference src/dispatcher.py:102-105)
+        # outs[0] is device-0's [T, B, out_sz_last] slice: what arrived at
+        # "the dispatcher" each step (reference src/dispatcher.py:102-105);
+        # the scan body already cropped it to the final stage's output size
         outs0 = outs[0]
         ready = []
         for j in range(c):
@@ -341,7 +387,7 @@ class SpmdPipeline:
             m = s - (n - 1)             # microbatch completing at step s
             if m < 0 or m >= self._fed:
                 continue
-            ready.append((m, outs0[j, :, :out_sz].reshape(out_shape)))
+            ready.append((m, outs0[j].reshape(out_shape)))
         self._step += c
 
         emitted = []
@@ -355,6 +401,25 @@ class SpmdPipeline:
                 emitted.append(arr)
         return emitted
 
+    def _bubble_block(self) -> jax.Array:
+        """Cached device-resident all-bubble [chunk, ...] input block."""
+        if self._flush_zeros is None:
+            self._flush_zeros = self.stage_inputs(
+                np.zeros((self.chunk, self.microbatch) + self.in_spec.shape,
+                         np.float32))
+        return self._flush_zeros
+
+    def warmup(self):
+        """Compile-and-run the exact full-chunk program that will serve
+        traffic, on bubbles, leaving the pipe empty.
+
+        The one probe recipe shared by ``Defer.health_check`` and the
+        dispatcher's preflight — and it seeds the same cached bubble block
+        ``flush`` drains with, so no extra host transfer."""
+        self.reset()
+        self.push(self._bubble_block(), n_real=0)
+        self.reset()
+
     def flush(self):
         """Drain the pipe: run bubble steps until every fed microbatch has
         emerged (the fill/drain of the classic pipeline schedule).
@@ -364,12 +429,9 @@ class SpmdPipeline:
         partial-size push would trigger a fresh XLA compile."""
         emitted = []
         target = self._fed  # overshoot bubbles beyond this are just ignored
-        if self._flush_zeros is None:
-            self._flush_zeros = self.stage_inputs(
-                np.zeros((self.chunk, self.microbatch) + self.in_spec.shape,
-                         np.float32))
+        block = self._bubble_block()
         while self._emitted < target:
-            emitted.extend(self.push(self._flush_zeros, n_real=0))
+            emitted.extend(self.push(block, n_real=0))
         return emitted
 
     # ------------------------------------------------------------------
@@ -406,18 +468,50 @@ class SpmdPipeline:
     # diagnostics
     # ------------------------------------------------------------------
 
-    def stage_latencies(self, params: dict[str, Any], iters: int = 10):
-        """Per-stage device latency (seconds), measured standalone."""
+    def stage_latencies(self, params: dict[str, Any] | None = None,
+                        iters: int = 10):
+        """Per-stage device latency (seconds) of the *deployed* program.
+
+        Times each stage's compiled branch — the same function the pipeline
+        scan dispatches — so the numbers reflect the deployment's compute
+        dtype, weight-buffer storage dtype, and (under TP) the Megatron
+        sharding, not a pristine f32 re-jit.  ``params`` is accepted for
+        backward compatibility but unused: the branch reads the pipeline's
+        own staged weight buffer.
+        """
+        del params  # weights come from the deployed buffer
         lats = []
-        for s in self.stages:
-            fn = jax.jit(s.fn)
-            sp = s.select_params(params)
-            x = jnp.zeros((self.microbatch,) + s.in_spec.shape,
-                          s.in_spec.dtype)
-            fn(sp, x).block_until_ready()  # compile
+        tp = self.tensor_parallel
+        tp_mesh = None
+        if tp > 1:
+            # submesh of the model axis: the tp devices hosting stage 0's
+            # ranks (any stage's rank group is equivalent for timing)
+            ax = list(self.mesh.axis_names)
+            devs = self.mesh.devices
+            sl = tuple(slice(None) if a == MODEL_AXIS else slice(0, 1)
+                       for a in ax)
+            tp_devs = devs[sl].reshape((tp,))
+            tp_mesh = Mesh(tp_devs, (MODEL_AXIS,))
+        for k in range(self.num_stages):
+            branch = self._branches[k]
+            a = jnp.zeros((self.microbatch, self.buf_elems),
+                          self.buffer_dtype)
+            # slice this stage's row on device — no full-buffer host
+            # round-trip (the buffer is the whole model's weights)
+            if tp_mesh is not None:
+                w_k = jax.device_put(
+                    self._w[k], NamedSharding(tp_mesh, P(MODEL_AXIS, None)))
+                fn = jax.jit(jax.shard_map(
+                    lambda w, a: branch(w[0], a), mesh=tp_mesh,
+                    in_specs=(P(MODEL_AXIS, None), P(None, None)),
+                    out_specs=P(None, None), check_vma=False))
+            else:
+                w_k = self._w[k]  # [Pmax]
+                fn = jax.jit(branch)
+            fn(w_k, a).block_until_ready()  # compile
             t0 = time.perf_counter()
             for _ in range(iters):
-                y = fn(sp, x)
+                y = fn(w_k, a)
             y.block_until_ready()
             lats.append((time.perf_counter() - t0) / iters)
         self.metrics.stage_latency_s = lats

@@ -44,5 +44,12 @@ class DeferConfig:
     # pipeline dispatch makes no progress for this many seconds the serve
     # thread is declared dead and readers unblocked (the reference has no
     # failure handling at all — a dead node hangs the chain forever,
-    # SURVEY.md §5; None disables)
-    watchdog_s: float | None = None
+    # SURVEY.md §5; None disables).  On by default with a generous bound:
+    # steady-state dispatches are milliseconds, and the first (compile)
+    # dispatch never arms the watchdog, so 60 s only ever fires on a dead
+    # device/backend.
+    watchdog_s: float | None = 60.0
+    # run a full-chunk bubble probe through the freshly built pipeline
+    # before serving traffic, so compile failures surface as handle.error
+    # immediately instead of mid-stream
+    preflight: bool = True

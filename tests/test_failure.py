@@ -71,3 +71,46 @@ def test_watchdog_declares_hung_dispatch(tiny, monkeypatch):
     assert isinstance(h.error, TimeoutError)
     assert not h.healthy
     h.stop()
+
+
+def test_failure_detection_defaults_on():
+    """r1 shipped watchdog_s=None — the reference's forever-hang as the
+    default config.  Pin the new contract: detection on out of the box."""
+    cfg = DeferConfig()
+    assert cfg.watchdog_s == 60.0
+    assert cfg.preflight is True
+
+
+def test_join_raises_immediately_when_error_set():
+    """join() must re-raise a recorded error even while the serve thread is
+    permanently wedged in a dead dispatch (it polls, never blocks forever)."""
+    import threading
+    from defer_tpu.runtime.dispatcher import DeferHandle
+
+    release = threading.Event()
+    th = threading.Thread(target=release.wait, daemon=True)
+    th.start()
+    h = DeferHandle(th, None, threading.Event())
+    h.error = TimeoutError("deployment declared dead")
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="dispatcher thread failed"):
+        h.join()  # unbounded join would hang here before the fix
+    assert time.monotonic() - t0 < 5
+    release.set()
+
+
+def test_preflight_surfaces_compile_failure_without_input(tiny):
+    """With preflight on, a deployment that cannot compile reports its error
+    and unblocks readers before any input is ever enqueued."""
+    g, p = tiny
+    # structurally valid params with broken shapes: building the pipeline
+    # succeeds, but the stage programs fail at trace time — exactly the
+    # failure class preflight exists to catch before traffic
+    bad = jax.tree.map(
+        lambda a: np.zeros(np.shape(a)[:-1] + (np.shape(a)[-1] + 1,),
+                           np.float32) if np.ndim(a) else a, p)
+    in_q, out_q = queue.Queue(), queue.Queue()
+    h = Defer(config=DeferConfig(microbatch=1, chunk=2)).run_defer(
+        g, bad, None, in_q, out_q, num_stages=2)
+    assert out_q.get(timeout=120) is END_OF_STREAM
+    assert not h.healthy

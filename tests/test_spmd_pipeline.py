@@ -135,3 +135,62 @@ def test_int_inputs_require_f32_buffer():
     out = pipe.run(inputs)
     ref = np.asarray(jax.jit(g.apply)(params, jnp.asarray(inputs[0])))
     np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_scan_output_cropped_to_final_stage(tiny):
+    """The per-step scan output carries only the final stage's output slice,
+    not the whole transfer buffer (VERDICT r1: ~100 MB/chunk of dead stores
+    on ResNet50 when the full [T, B, buf_elems] buffer was stacked)."""
+    g, params = tiny
+    stages = partition(g, num_stages=4)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(4),
+                        microbatch=1, chunk=4)
+    out_sz = stages[-1].out_spec.size
+    assert out_sz < pipe.buf_elems  # the crop must actually save something
+    a_aval = jax.ShapeDtypeStruct(pipe._a.shape, pipe._a.dtype)
+    xs_aval = jax.ShapeDtypeStruct((pipe.chunk, 1, pipe.buf_elems),
+                                   pipe.buffer_dtype)
+    _, outs = jax.eval_shape(pipe._chunk_fn, pipe._w, a_aval, xs_aval)
+    assert outs.shape == (4, pipe.chunk, 1, out_sz)
+
+
+def test_weight_buffer_stored_in_compute_dtype(tiny):
+    """compute_dtype deployments hold the weight buffer in that dtype
+    (half the HBM for bf16) and still match the f32 program closely."""
+    g, params = tiny
+    stages = partition(g, num_stages=2)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(2),
+                        microbatch=1, chunk=4,
+                        buffer_dtype=jnp.bfloat16,
+                        compute_dtype=jnp.bfloat16)
+    assert pipe._w.dtype == jnp.bfloat16
+    inputs = np.asarray(jax.random.normal(jax.random.key(2), (4, 1, 32, 32, 3)))
+    out = pipe.run(inputs)
+    ref = _reference(g, params, inputs)
+    np.testing.assert_allclose(out, ref, rtol=0.15, atol=0.2)
+    # f32 default unchanged
+    pipe32 = SpmdPipeline(stages, params, mesh=pipeline_mesh(2))
+    assert pipe32._w.dtype == jnp.float32
+
+
+def test_int_param_leaf_guard():
+    """Integer param leaves must survive the weight buffer exactly or fail
+    loudly (the flat-buffer abstraction's round-trip trap, VERDICT r1)."""
+    from defer_tpu.graph.ir import GraphBuilder
+    from defer_tpu.graph import ops
+
+    b = GraphBuilder("toy_embed")
+    x = b.input((4,), jnp.int32)
+    e = b.add(ops.Embedding(vocab=300, features=8), x, name="embed")
+    b.add(ops.Dense(4), e, name="head")
+    g = b.build()
+    params = g.init(jax.random.key(0))
+    # graft an int32 leaf that cannot survive a bf16 buffer
+    params = dict(params)
+    params["embed"] = dict(params["embed"], steps=np.array([1, 301, 7], np.int32))
+    stages = partition(g, ["embed"])
+    with pytest.raises(ValueError, match="non-float param leaf"):
+        SpmdPipeline(stages, params, mesh=pipeline_mesh(2),
+                     compute_dtype=jnp.bfloat16)
+    # exact in the f32 buffer -> accepted
+    SpmdPipeline(stages, params, mesh=pipeline_mesh(2))
