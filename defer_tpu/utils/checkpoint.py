@@ -31,12 +31,16 @@ def _npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
-def _flatten(params: dict[str, Any]) -> dict[str, np.ndarray]:
+def _flatten(params: dict[str, Any], materialize: bool = True
+             ) -> dict[str, Any]:
+    """Flat key->leaf map; ``materialize=False`` keeps leaves as-is so
+    shape-only trees (``jax.eval_shape`` output) can be used as templates."""
     flat = {}
     for node, sub in params.items():
         leaves_with_paths = jax.tree_util.tree_flatten_with_path(sub)[0]
         for path, leaf in leaves_with_paths:
-            flat[_leaf_key(node, path)] = np.asarray(leaf)
+            flat[_leaf_key(node, path)] = np.asarray(leaf) if materialize \
+                else leaf
     return flat
 
 
@@ -58,7 +62,7 @@ def load_params(path: str, like: dict[str, Any]) -> dict[str, Any]:
     with np.load(_npz_path(path)) as data:
         stored = dict(data)
     out: dict[str, Any] = {}
-    expected = _flatten(like)
+    expected = _flatten(like, materialize=False)
     missing = set(expected) - set(stored)
     extra = set(stored) - set(expected)
     if missing or extra:
