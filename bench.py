@@ -33,28 +33,12 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-# bf16 peak FLOP/s per chip, by generation (public spec sheets)
-PEAK_BF16_FLOPS = {
-    "v2": 46e12,
-    "v3": 123e12,
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-
 def chip_peak_flops(device) -> tuple[str, float]:
     """(generation, bf16 peak FLOP/s) for ``device``; (unknown, 0) if the
     chip can't be identified — MFU is only reported against a real peak."""
-    kind = str(getattr(device, "device_kind", "")).lower().replace(" ", "")
-    env_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for gen in ("v6e", "v5p", "v5e", "v4", "v3", "v2"):
-        if gen in kind or gen == env_gen:
-            return gen, PEAK_BF16_FLOPS[gen]
-    if "v5lite" in kind or "v5litepod" in kind:
-        return "v5e", PEAK_BF16_FLOPS["v5e"]
-    return "unknown", 0.0
+    from defer_tpu.utils.hw import identify_chip, peak_flops
+    gen = identify_chip(device)
+    return gen, peak_flops(gen)
 
 
 def probe_tpu_subprocess(timeout_s: float) -> tuple[str | None, str]:

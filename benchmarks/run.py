@@ -125,10 +125,15 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool):
         pipe.push(xs, n_real=chunk)
         jax.block_until_ready(pipe._a)
 
-    pipe.reset()
+    pipe.warmup()
     pipe_s = timed(push_chunk) / chunk
+    lats = None
     if stage_lat:
-        pipe.stage_latencies(params)
+        lats = pipe.stage_latencies()
+
+    from defer_tpu.graph.analysis import total_flops
+    from defer_tpu.utils.hw import (analytic_pipeline_model, ici_bandwidth,
+                                    identify_chip, peak_flops)
 
     m = pipe.metrics.as_dict()
     result = {
@@ -143,6 +148,19 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool):
         "pipeline_efficiency": m["pipeline_efficiency"],
         "buffer_bytes_per_hop": m["buffer_bytes_per_hop"],
     }
+    gen = identify_chip(jax.devices()[0])
+    peak = peak_flops(gen) if on_tpu else 0.0
+    if peak > 0:
+        # the pipeline spans len(stages) chips: utilization is against the
+        # aggregate peak, not one chip's
+        result["mfu"] = round(
+            float(total_flops(graph)) / pipe_s / (peak * len(stages)), 4)
+    if lats:
+        # the written multi-chip argument: what an N-chip pipeline of these
+        # measured stages would do, and where it loses vs ideal N
+        result["analytic"] = analytic_pipeline_model(
+            lats, m["buffer_bytes_per_hop"],
+            ici_bandwidth(gen) if on_tpu else 0.0)
     return result
 
 

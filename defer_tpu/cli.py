@@ -9,6 +9,10 @@ deployment from one controller:
   models     list the model zoo
   partition  show the stage table for a model + cut spec (DOT optional)
   bench      timed-window pipeline throughput vs single-device baseline
+  export     write per-stage StableHLO artifacts for a partition
+  node       run one standalone stage node (recv -> stage -> relay), the
+             working equivalent of the reference's ``python node.py``
+  chain      export + spawn N local node processes + stream + verify
 """
 
 from __future__ import annotations
@@ -102,6 +106,65 @@ def cmd_bench(args):
         **pipe.metrics.as_dict()}))
 
 
+def cmd_export(args):
+    import jax
+
+    from . import partition
+    from .utils.export import export_pipeline
+
+    graph = _get_model(args.model)
+    params = graph.init(jax.random.key(0))
+    cuts = args.cuts.split(",") if args.cuts else None
+    stages = partition(graph, cuts, num_stages=args.stages)
+    paths = export_pipeline(stages, params, args.out, batch=args.batch)
+    for p in paths:
+        print(p)
+
+
+def cmd_node(args):
+    from .runtime.node import StageNode
+
+    node = StageNode(args.artifact, args.listen, args.next,
+                     codec=args.codec)
+    print(f"node: stage {node.manifest['index']} "
+          f"({node.manifest['name']}) listening on "
+          f"{node.address[0]}:{node.address[1]}, next {args.next}",
+          file=sys.stderr, flush=True)
+    n = node.serve(connect_timeout_s=args.connect_timeout)
+    print(f"node: served {n} tensors; chain drained", file=sys.stderr)
+
+
+def cmd_chain(args):
+    import jax
+
+    from . import partition
+    from .runtime.node import run_chain
+
+    graph = _get_model(args.model)
+    params = graph.init(jax.random.key(0))
+    cuts = args.cuts.split(",") if args.cuts else None
+    stages = partition(graph, cuts, num_stages=args.stages)
+    in_spec = stages[0].in_spec
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((args.batch,) + in_spec.shape)
+          .astype(np.float32) for _ in range(args.count)]
+
+    t0 = time.perf_counter()
+    outs = run_chain(stages, params, xs, batch=args.batch, codec=args.codec)
+    dt = time.perf_counter() - t0
+
+    fwd = jax.jit(graph.apply)
+    worst = max(float(np.abs(np.asarray(fwd(params, x)) - y).max())
+                for x, y in zip(xs, outs))
+    print(json.dumps({
+        "metric": f"{args.model}_{len(stages)}proc_chain",
+        "value": round(len(xs) * args.batch / dt, 3),
+        "unit": "inferences/sec",
+        "stages": len(stages), "codec": args.codec,
+        "max_abs_err_vs_single_program": worst,
+    }))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m defer_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -124,9 +187,37 @@ def main(argv=None):
     b.add_argument("--wire", default="buffer", choices=["buffer", "int8"])
     b.add_argument("--seconds", type=float, default=5.0)
 
+    e = sub.add_parser("export", help="write per-stage StableHLO artifacts")
+    e.add_argument("--model", required=True)
+    e.add_argument("--stages", type=int)
+    e.add_argument("--cuts")
+    e.add_argument("--out", required=True)
+    e.add_argument("--batch", type=int, default=1)
+
+    nd = sub.add_parser("node", help="run one standalone stage node")
+    nd.add_argument("--artifact", required=True)
+    nd.add_argument("--listen", required=True, metavar="[host]:port")
+    nd.add_argument("--next", required=True, metavar="host:port",
+                    help="successor hop (last node: the dispatcher's "
+                         "result port)")
+    nd.add_argument("--codec", default="raw",
+                    choices=["raw", "lzb", "bf8", "bf12", "bf16"])
+    nd.add_argument("--connect-timeout", type=float, default=30.0)
+
+    c = sub.add_parser("chain", help="spawn a local N-process chain and "
+                                     "verify vs the single program")
+    c.add_argument("--model", default="resnet_tiny")
+    c.add_argument("--stages", type=int, default=3)
+    c.add_argument("--cuts")
+    c.add_argument("--batch", type=int, default=1)
+    c.add_argument("--count", type=int, default=8)
+    c.add_argument("--codec", default="raw",
+                   choices=["raw", "lzb", "bf8", "bf12", "bf16"])
+
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition,
-     "bench": cmd_bench}[args.cmd](args)
+     "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
+     "chain": cmd_chain}[args.cmd](args)
 
 
 if __name__ == "__main__":

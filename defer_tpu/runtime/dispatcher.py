@@ -230,6 +230,7 @@ class Defer:
                     if handle.error is not None:
                         return
                 seen_shapes: set[tuple] = set()
+                pipe.reset()
                 while not stop.is_set():
                     try:
                         x = input_stream.get(timeout=0.05)
@@ -242,10 +243,25 @@ class Defer:
                     # let the watchdog mistake compile time for a hang
                     fresh = xa.shape not in seen_shapes
                     seen_shapes.add(xa.shape)
-                    y = _dispatch(pipe.run, xa[None], arm=not fresh)[0]
+                    # materialize INSIDE the dispatch bracket: push only
+                    # enqueues async work, and a wedged device would
+                    # otherwise hang np.asarray with the watchdog disarmed
+                    outs = _dispatch(
+                        lambda: [np.asarray(o, np.float32)
+                                 for o in pipe.push(xa[None])],
+                        arm=not fresh)
                     if handle.error is not None:
                         return  # watchdog fired mid-dispatch
-                    output_stream.put(y)
+                    for o in outs:
+                        output_stream.put(o)
+                if handle.error is not None:
+                    return
+                outs = _dispatch(lambda: [np.asarray(o, np.float32)
+                                          for o in pipe.flush()])
+                if handle.error is not None:
+                    return
+                for o in outs:
+                    output_stream.put(o)
                 return
 
             pipe.reset()
@@ -277,22 +293,27 @@ class Defer:
                     batch.append(nxt)
                 n_real = len(batch)
                 pad = [np.zeros_like(batch[0])] * (pipe.chunk - n_real)
-                outs = _dispatch(pipe.push, np.stack(batch + pad),
-                                 n_real=n_real)
+                block = np.stack(batch + pad)
+                # materialize inside the bracket (push is async dispatch;
+                # the device block happens at np.asarray)
+                outs = _dispatch(
+                    lambda: [np.asarray(o, np.float32)
+                             for o in pipe.push(block, n_real=n_real)])
                 if handle.error is not None:
                     return  # watchdog fired mid-dispatch; sentinel is out
                 for o in outs:
-                    output_stream.put(np.asarray(o, np.float32))
+                    output_stream.put(o)
             if handle.error is not None:
                 return
-            outs = _dispatch(pipe.flush)
+            outs = _dispatch(lambda: [np.asarray(o, np.float32)
+                                      for o in pipe.flush()])
             if handle.error is not None:
                 # watchdog fired during the drain dispatch: the sentinel is
                 # already on the queue; emitting outputs after it would
                 # violate the stream protocol for readers
                 return
             for o in outs:
-                output_stream.put(np.asarray(o, np.float32))
+                output_stream.put(o)
 
         thread = threading.Thread(target=serve, daemon=True,
                                   name="defer-dispatcher")

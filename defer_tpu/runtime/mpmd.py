@@ -16,6 +16,8 @@ wasteful (SURVEY.md §7 model B).
 
 from __future__ import annotations
 
+import collections
+import time
 from typing import Any, Sequence
 
 import numpy as np
@@ -25,10 +27,14 @@ import jax.numpy as jnp
 
 from ..partition.stage import StageSpec
 from ..utils.metrics import PipelineMetrics
-import time
 
 
 class MpmdPipeline:
+    """Per-stage jit programs + device_put relay, with the same streaming
+    contract as :class:`SpmdPipeline`: ``reset`` / ``push`` / ``flush`` /
+    ``warmup`` / ``run`` — so ``mode="mpmd"`` is a drop-in fallback for the
+    dispatcher, not just a batch oracle."""
+
     def __init__(self, stages: Sequence[StageSpec], params: dict[str, Any],
                  *, devices=None, microbatch: int = 1, compute_dtype=None):
         self.stages = list(stages)
@@ -40,7 +46,13 @@ class MpmdPipeline:
         self.devices = [devices[i % len(devices)] for i in range(n)]
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype else None
 
-        self._fns = [jax.jit(s.fn) for s in self.stages]
+        # donate the activation so XLA reuses its buffer stage over stage
+        # (the relay's HBM footprint stays one activation per in-flight
+        # microbatch, like the SPMD transfer buffer); CPU has no donation,
+        # skip it there to keep test logs clean
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._fns = [jax.jit(s.fn, donate_argnums=donate)
+                     for s in self.stages]
         self._params = [
             jax.device_put(s.select_params(params), d)
             for s, d in zip(self.stages, self.devices)
@@ -48,35 +60,97 @@ class MpmdPipeline:
         self.in_spec = self.stages[0].in_spec
         self.out_spec = self.stages[-1].out_spec
         self.metrics = PipelineMetrics(num_stages=n)
+        self.reset()
+
+    # ------------------------------------------------------------------
+    # streaming interface (mirrors SpmdPipeline)
+    # ------------------------------------------------------------------
+
+    def reset(self):
+        """Empty the in-flight window."""
+        self._inflight: collections.deque[tuple[jax.Array, bool]] = \
+            collections.deque()
+
+    def _issue(self, x_np) -> jax.Array:
+        """Issue one microbatch through every stage without blocking —
+        JAX async dispatch is the in-flight pipelining (the reference's
+        bounded queue, src/node.py:114)."""
+        x = jnp.asarray(x_np, self.in_spec.dtype)
+        if self.compute_dtype is not None and jnp.issubdtype(
+                self.in_spec.dtype, jnp.floating):
+            x = x.astype(self.compute_dtype)
+        x = jax.device_put(x, self.devices[0])
+        for k in range(self.num_stages):
+            y = self._fns[k](self._params[k], x)
+            if k + 1 < self.num_stages \
+                    and self.devices[k + 1] != self.devices[k]:
+                y = jax.device_put(y, self.devices[k + 1])
+            x = y
+        return x
+
+    def push(self, xs: np.ndarray, n_real: int | None = None):
+        """Issue ``xs`` ([C, microbatch, *in_shape]); return microbatches
+        that have left the in-flight window (depth = pipeline depth), in
+        feed order — the same contract as ``SpmdPipeline.push``."""
+        xs = np.asarray(xs)
+        c = xs.shape[0]
+        if n_real is None:
+            n_real = c
+        t0 = time.perf_counter()
+        emitted = []
+        for j in range(c):
+            self._inflight.append((self._issue(xs[j]), j < n_real))
+            while len(self._inflight) > self.num_stages:
+                arr, real = self._inflight.popleft()
+                if real:
+                    emitted.append(arr)
+                    self.metrics.inferences += self.microbatch
+        # block on what we hand back (the oldest in-flight work — normally
+        # already complete) so wall_s measures execution, not just async
+        # enqueue; newer microbatches stay in flight
+        if emitted:
+            jax.block_until_ready(emitted)
+        self.metrics.steps += c
+        self.metrics.chunk_calls += 1
+        self.metrics.wall_s += time.perf_counter() - t0
+        return emitted
+
+    def flush(self):
+        """Drain the in-flight window; returns remaining outputs in order."""
+        emitted = []
+        t0 = time.perf_counter()
+        while self._inflight:
+            arr, real = self._inflight.popleft()
+            if real:
+                emitted.append(arr)
+                self.metrics.inferences += self.microbatch
+        if emitted:
+            jax.block_until_ready(emitted)
+        self.metrics.wall_s += time.perf_counter() - t0
+        return emitted
+
+    def warmup(self):
+        """Compile every stage program on one bubble microbatch."""
+        self.reset()
+        bubble = np.zeros((1, self.microbatch) + self.in_spec.shape,
+                          np.float32)
+        self.push(bubble, n_real=0)
+        self.flush()
+        self.reset()
+
+    # ------------------------------------------------------------------
+    # batch convenience
+    # ------------------------------------------------------------------
 
     def run(self, inputs: np.ndarray) -> np.ndarray:
-        """[M, microbatch, *in_shape] -> [M, microbatch, *out_shape].
-
-        All M microbatches are issued without blocking; async dispatch keeps
-        every stage device busy on a different in-flight microbatch.
-        """
+        """[M, microbatch, *in_shape] -> [M, microbatch, *out_shape]."""
         inputs = np.asarray(inputs)
-        m = inputs.shape[0]
-        t0 = time.perf_counter()
-        outs = []
-        for i in range(m):
-            x = jnp.asarray(inputs[i], self.in_spec.dtype)
-            if self.compute_dtype is not None and jnp.issubdtype(
-                    self.in_spec.dtype, jnp.floating):
-                x = x.astype(self.compute_dtype)
-            x = jax.device_put(x, self.devices[0])
-            for k in range(self.num_stages):
-                y = self._fns[k](self._params[k], x)
-                if k + 1 < self.num_stages \
-                        and self.devices[k + 1] != self.devices[k]:
-                    y = jax.device_put(y, self.devices[k + 1])
-                x = y
-            outs.append(x)
-        result = np.stack([np.asarray(jax.device_get(o), np.float32)
-                           for o in outs])
-        self.metrics.wall_s += time.perf_counter() - t0
-        self.metrics.inferences += m * self.microbatch
-        return result
+        self.reset()
+        outs = self.push(inputs)
+        outs.extend(self.flush())
+        assert len(outs) == inputs.shape[0], (len(outs), inputs.shape[0])
+        return np.stack([np.asarray(jax.device_get(o), np.float32)
+                         for o in outs])
 
     def __call__(self, inputs: np.ndarray) -> np.ndarray:
         return self.run(inputs)

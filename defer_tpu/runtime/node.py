@@ -1,0 +1,277 @@
+"""Standalone stage-node processes: the multi-process MPMD chain.
+
+Reference parity: the reference's compute node is a separate process on
+another machine that receives its partition, then serves the chain forever —
+recv activation, predict, relay to its successor (reference
+src/node.py:80-108, boot at src/node.py:110-127).  The last node relays back
+to the dispatcher (reference src/dispatcher.py:51-55).
+
+The TPU-native redesign keeps the topology but none of the machinery:
+
+* The partition arrives as a *compiled artifact* — StableHLO + weights
+  (``utils/export.py``) loaded with zero model code — not Keras JSON
+  rebuilt layer by layer (src/node.py:31-37).
+* One typed framed connection per hop (``transport/framed.py``) instead of
+  three fixed ports; the hop codec (raw / lzb / blockfloat) is the ZFP+LZ4
+  analogue and is *symmetric* (the reference's decode sides are buggy,
+  SURVEY.md §3.5).
+* Readiness is connect-with-retry, not 5-second poll loops
+  (src/node.py:33,96), and shutdown is an in-band END frame that cascades
+  down the chain, not process kill.
+
+The SPMD mesh engine (``runtime/spmd.py``) is the primary execution model;
+this chain exists for the reference's one topology it doesn't cover —
+stages as separate processes/hosts with a network between them.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..transport.framed import (K_END, K_TENSOR, recv_frame, send_end,
+                                send_frame)
+
+
+def _connect_retry(host: str, port: int, timeout_s: float = 30.0
+                   ) -> socket.socket:
+    """Connect, retrying while the peer boots (replaces the reference's
+    sleep-5 polling rendezvous, src/node.py:95-96)."""
+    deadline = time.monotonic() + timeout_s
+    delay = 0.05
+    while True:
+        try:
+            return socket.create_connection((host, port), timeout=timeout_s)
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+
+def _parse_hostport(s: str, default_host: str = "127.0.0.1"
+                    ) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host or default_host), int(port)
+
+
+class StageNode:
+    """One compute node of a process chain: recv -> stage fn -> relay.
+
+    ``python -m defer_tpu node --artifact stage_k.zip --listen :5000
+    --next host:5000`` is the working equivalent of the reference's
+    ``python node.py`` (src/node.py:126-127).
+    """
+
+    def __init__(self, artifact: str, listen: str, next_hop: str,
+                 *, codec: str = "raw"):
+        from ..utils.export import load_stage
+        # bind before the (slow: jax import + StableHLO deserialize)
+        # artifact load so upstream connect-retries land as soon as the
+        # process exists
+        host, port = _parse_hostport(listen, "0.0.0.0")
+        self._srv = socket.create_server((host, port))
+        self.address = self._srv.getsockname()
+        self.fn, self.manifest = load_stage(artifact)
+        self.next_hop = _parse_hostport(next_hop)
+        self.codec = codec
+
+    def serve(self, *, connect_timeout_s: float = 30.0) -> int:
+        """Accept one upstream connection and relay until its END frame.
+
+        Returns the number of tensors processed.  The END frame is
+        forwarded downstream before closing, so shutdown cascades through
+        the chain to the dispatcher's result server.
+        """
+        conn, _ = self._srv.accept()
+        out = _connect_retry(*self.next_hop, timeout_s=connect_timeout_s)
+        n = 0
+        want = tuple(self.manifest["in_shape"])
+        try:
+            while True:
+                kind, value = recv_frame(conn)
+                if kind == K_END:
+                    send_end(out)
+                    return n
+                if kind != K_TENSOR:
+                    raise ValueError(f"unexpected frame kind {kind}")
+                if tuple(value.shape[1:]) != want:
+                    raise ValueError(
+                        f"stage {self.manifest['index']} expects sample "
+                        f"shape {want}, got {tuple(value.shape[1:])}")
+                y = np.asarray(self.fn(value))
+                send_frame(out, y, codec=self.codec)
+                n += 1
+        finally:
+            out.close()
+            conn.close()
+            self._srv.close()
+
+
+class ChainDispatcher:
+    """Drives a chain of stage-node processes from one controller.
+
+    Opens the result server (the reference dispatcher's own port 5000 role,
+    src/dispatcher.py:95-105), streams inputs to node 0, and yields results
+    in order.  Strictly in-flight-window'd so the chain stays full without
+    unbounded buffering.
+    """
+
+    def __init__(self, first_hop: str, *, listen: str = "127.0.0.1:0",
+                 codec: str = "raw", window: int = 64,
+                 timeout_s: float = 180.0):
+        host, port = _parse_hostport(listen)
+        self._res_srv = socket.create_server((host, port))
+        self._res_srv.settimeout(timeout_s)  # a dead chain fails, not hangs
+        self.result_address = self._res_srv.getsockname()
+        self.first_hop = first_hop
+        self.codec = codec
+        self.window = window
+        self.timeout_s = timeout_s
+        self._send_sock: socket.socket | None = None
+        self._res_conn: socket.socket | None = None
+
+    def _ensure_connected(self):
+        if self._send_sock is None:
+            # generous: every node in the chain cold-imports jax first
+            self._send_sock = _connect_retry(
+                *_parse_hostport(self.first_hop), timeout_s=self.timeout_s)
+        if self._res_conn is None:
+            self._res_conn, _ = self._res_srv.accept()
+            self._res_conn.settimeout(self.timeout_s)
+
+    def stream(self, inputs) -> list[np.ndarray]:
+        """Send every input through the chain; return outputs in order."""
+        outs: list[np.ndarray] = []
+        self._ensure_connected()
+        in_flight = 0
+        for x in inputs:
+            send_frame(self._send_sock, np.asarray(x), codec=self.codec)
+            in_flight += 1
+            if in_flight >= self.window:
+                kind, y = recv_frame(self._res_conn)
+                assert kind == K_TENSOR
+                outs.append(y)
+                in_flight -= 1
+        while in_flight:
+            kind, y = recv_frame(self._res_conn)
+            assert kind == K_TENSOR
+            outs.append(y)
+            in_flight -= 1
+        return outs
+
+    def close(self):
+        """Drain the chain (best effort) and close every socket.
+
+        The graceful END handshake is wrapped so a chain that already died
+        mid-stream can't mask the original failure with a secondary
+        BrokenPipe/EOF from the teardown itself."""
+        try:
+            if self._send_sock is not None:
+                send_end(self._send_sock)
+                if self._res_conn is not None:
+                    # drain any leftover in-flight frames until the END
+                    # cascades through
+                    while True:
+                        kind, _ = recv_frame(self._res_conn)
+                        if kind == K_END:
+                            break
+        except (OSError, ConnectionError, ValueError):
+            pass  # teardown after failure: keep the root cause
+        finally:
+            if self._send_sock is not None:
+                self._send_sock.close()
+            if self._res_conn is not None:
+                self._res_conn.close()
+            self._res_srv.close()
+
+
+def _free_ports(n: int) -> list[int]:
+    socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_chain(stages: Sequence, params: dict[str, Any], inputs,
+              *, batch: int = 1, codec: str = "raw",
+              artifact_dir: str | None = None,
+              env: dict[str, str] | None = None) -> list[np.ndarray]:
+    """Export, spawn one OS process per stage, stream, and tear down.
+
+    The one-call analogue of the reference's whole deployment procedure
+    (start N ``node.py`` processes, run the dispatcher, src/dispatcher.py:
+    44-65 + test/test.py) — used by the CLI ``chain`` command and the
+    multi-process integration test.
+
+    ``env`` overrides the child environment.  By default children are
+    pinned to the CPU backend: a local chain is a topology demonstration,
+    and N child processes racing the parent for a single-client TPU would
+    deadlock (this host's tunnel admits exactly one client).  Real
+    multi-host deployments run ``python -m defer_tpu node`` per host with
+    each host's own accelerator environment instead.
+    """
+    from ..utils.export import export_pipeline
+
+    tmp = None
+    if artifact_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="defer_chain_")
+        artifact_dir = tmp.name
+    try:
+        paths = export_pipeline(stages, params, artifact_dir, batch=batch)
+        n = len(paths)
+        ports = _free_ports(n + 1)  # node listen ports + result port
+        result_port = ports[-1]
+
+        child_env = dict(os.environ)
+        if env is None:
+            env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        child_env.update(env)
+
+        procs, logs = [], []
+        for i, p in enumerate(paths):
+            nxt = (f"127.0.0.1:{ports[i + 1]}" if i + 1 < n
+                   else f"127.0.0.1:{result_port}")
+            # log to files, not PIPEs: an undrained pipe fills and
+            # deadlocks a chatty child mid-chain
+            lf = open(os.path.join(artifact_dir, f"node_{i}.log"), "w+")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "defer_tpu", "node",
+                 "--artifact", p, "--listen", f"127.0.0.1:{ports[i]}",
+                 "--next", nxt, "--codec", codec],
+                env=child_env, stdout=lf, stderr=subprocess.STDOUT))
+
+        disp = ChainDispatcher(f"127.0.0.1:{ports[0]}",
+                               listen=f"127.0.0.1:{result_port}",
+                               codec=codec)
+        try:
+            outs = disp.stream(inputs)
+        finally:
+            disp.close()
+            for pr in procs:
+                try:
+                    pr.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+        for i, pr in enumerate(procs):
+            if pr.returncode not in (0, None):
+                logs[i].seek(0)
+                raise RuntimeError(
+                    f"stage node {i} exited rc={pr.returncode}: "
+                    f"{logs[i].read()[-2000:]}")
+        return outs
+    finally:
+        for lf in locals().get("logs", []):
+            lf.close()
+        if tmp is not None:
+            tmp.cleanup()

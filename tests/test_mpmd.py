@@ -34,3 +34,27 @@ def test_mpmd_more_stages_than_devices():
     fn = jax.jit(g.apply)
     ref = np.stack([np.asarray(fn(params, x), np.float32) for x in inputs])
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_mpmd_streaming_contract_matches_run():
+    """push/flush streaming emits the same outputs as the batch API (the
+    SPMD pipeline's contract, now honored by the fallback engine too)."""
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    stages = partition(g, num_stages=4)
+    pipe = MpmdPipeline(stages, params, microbatch=1)
+    inputs = np.asarray(
+        jax.random.normal(jax.random.key(3), (7, 1, 32, 32, 3)))
+    pipe.warmup()
+    pipe.reset()
+    outs = pipe.push(inputs[:3])
+    outs += pipe.push(inputs[3:])
+    outs += pipe.flush()
+    assert len(outs) == 7
+    ref = pipe.run(inputs)
+    got = np.stack([np.asarray(o, np.float32) for o in outs])
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    # in-flight window is bounded by pipeline depth during streaming
+    pipe.reset()
+    pipe.push(inputs[:4], n_real=4)
+    assert len(pipe._inflight) <= pipe.num_stages

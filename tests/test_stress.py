@@ -70,3 +70,50 @@ def test_streaming_order_and_exactly_once_under_jitter():
     for i in range(0, n, 16):
         want = np.asarray(jax.jit(g.apply)(p, xs[i][None])[0])
         np.testing.assert_allclose(got[i][0], want, rtol=1e-4, atol=1e-4)
+
+
+def test_streaming_mpmd_mode_under_jitter():
+    """The same exactly-once / in-order contract with the MPMD fallback
+    engine serving the queue (mode='mpmd' is a real fallback, not just a
+    batch oracle)."""
+    g = resnet_tiny()
+    p = g.init(jax.random.key(0))
+    n = 24
+    xs = np.random.default_rng(3).normal(
+        size=(n, 1, 32, 32, 3)).astype(np.float32)
+
+    defer = Defer(config=DeferConfig(microbatch=1, mode="mpmd"))
+    in_q: queue.Queue = queue.Queue(maxsize=6)
+    out_q: queue.Queue = queue.Queue()
+    h = defer.run_defer(g, p, None, in_q, out_q, num_stages=4)
+
+    def produce():
+        rng = np.random.default_rng(4)
+        for i in range(n):
+            in_q.put(xs[i])
+            if rng.random() < 0.3:
+                time.sleep(rng.random() * 0.005)
+        in_q.put(END_OF_STREAM)
+
+    got = []
+
+    def consume():
+        while True:
+            o = out_q.get(timeout=120)
+            if o is END_OF_STREAM:
+                return
+            got.append(np.asarray(o))
+
+    tp = threading.Thread(target=produce)
+    tc = threading.Thread(target=consume)
+    tp.start(); tc.start()
+    tp.join(timeout=300)
+    h.join(timeout=300)
+    out_q.put(END_OF_STREAM)
+    tc.join(timeout=300)
+
+    assert h.healthy
+    assert len(got) == n
+    for i in range(0, n, 8):
+        want = np.asarray(jax.jit(g.apply)(p, xs[i]))
+        np.testing.assert_allclose(got[i], want, rtol=1e-4, atol=1e-4)
