@@ -20,12 +20,20 @@ import jax.numpy as jnp
 BLOCK = 256
 
 
-def quantize_int8_blocks(x: jnp.ndarray):
+def quantize_int8_blocks(x: jnp.ndarray, use_pallas: bool | None = None):
     """[..., L] float -> ([..., L] int8, [..., L/BLOCK] f32 scales).
 
     L must be a multiple of BLOCK (the pipeline pads its transfer buffer
     up-front).  Non-finite inputs are flushed to 0 like the host codec.
+    On TPU the fused Pallas kernel (``ops/quant_pallas.py``) runs instead
+    of this jnp reference; pass ``use_pallas`` to force either path.
     """
+    import jax
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        from .quant_pallas import quantize_int8_blocks_pallas
+        return quantize_int8_blocks_pallas(x)
     *lead, n = x.shape
     if n % BLOCK:
         raise ValueError(f"last dim {n} not a multiple of {BLOCK}")

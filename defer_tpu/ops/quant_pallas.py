@@ -1,0 +1,74 @@
+"""Pallas TPU kernel for block-scale int8 wire quantization.
+
+One VMEM pass fuses the whole quantize step the jnp reference
+(``ops/quant.py``) expresses as amax -> scale -> divide -> round -> clip:
+each grid step DMAs one row-tile of the transfer buffer into VMEM, computes
+per-256-value-block scales, and stores the int8 payload plus f32 scales.
+This is the hot half of the ``wire="int8"`` path (it runs every pipeline
+step on every device, immediately before the stage->stage ``ppermute`` —
+runtime/spmd.py); dequantize stays plain jnp because XLA fuses a single
+multiply into the consuming stage for free.
+
+Off-TPU the identical kernel runs in interpreter mode (same math, one
+implementation) — the pattern established by ``ops/flash_attention.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .quant import BLOCK
+
+#: row-tile width per grid step (multiple of BLOCK; 8 blocks = 2 KiB int8)
+_TILE = 8 * BLOCK
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...].astype(jnp.float32)          # [1, tile]
+    xb = x.reshape(-1, BLOCK)                   # [tile/BLOCK, BLOCK]
+    xb = jnp.where(jnp.isfinite(xb), xb, 0.0)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
+    q_ref[...] = q.reshape(x_ref.shape)
+    s_ref[...] = scale.reshape(s_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_int8_blocks_pallas(x: jnp.ndarray,
+                                interpret: bool | None = None):
+    """Drop-in Pallas version of ``quant.quantize_int8_blocks``.
+
+    [..., L] float -> ([..., L] int8, [..., L/BLOCK] f32), L % BLOCK == 0.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    *lead, n = x.shape
+    if n % BLOCK:
+        raise ValueError(f"last dim {n} not a multiple of {BLOCK}")
+    rows = 1
+    for d in lead:
+        rows *= d
+    xf = x.reshape(rows, n)
+
+    tile = _TILE if n % _TILE == 0 else BLOCK
+    grid = (rows, n // tile)
+    q, s = pl.pallas_call(
+        _quant_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, tile), lambda r, c: (r, c))],
+        out_specs=[
+            pl.BlockSpec((1, tile), lambda r, c: (r, c)),
+            pl.BlockSpec((1, tile // BLOCK), lambda r, c: (r, c)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, n), jnp.int8),
+            jax.ShapeDtypeStruct((rows, n // BLOCK), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xf)
+    return q.reshape(*lead, n), s.reshape(*lead, n // BLOCK)

@@ -184,6 +184,95 @@ class Defer:
             yield from pipe.push(np.stack(batch + pad), n_real=len(batch))
         yield from pipe.flush()
 
+    def serve_endpoint(self, graph, params, cut_points=None, *,
+                       num_stages=None, host: str = "127.0.0.1",
+                       port: int = 0, codec: str = "raw"):
+        """Network front door: accept framed tensors, stream them through
+        the pipeline via the native staging ring, reply in order.
+
+        This is the reference dispatcher's whole socket data plane
+        (src/dispatcher.py:85-105) as one endpoint: a reader thread pushes
+        incoming samples into the bounded native ring
+        (``transport/staging.py``); the serve loop drains whole chunk
+        blocks already laid out like the device transfer buffer and feeds
+        the SPMD engine; results flow back on the same connection.
+        Returns ``(server_address, thread)``; the thread exits after the
+        client's END frame has been fully drained and echoed.
+        """
+        import socket as _socket
+
+        from ..transport.framed import (K_END, K_TENSOR, recv_frame,
+                                        send_end, send_frame)
+        from ..transport.staging import HostStagingRing
+
+        pipe = self.build(graph, params, cut_points, num_stages)
+        if isinstance(pipe, MpmdPipeline):
+            raise ValueError("serve_endpoint requires spmd mode")
+        pipe.warmup()
+        mb, buf = pipe.microbatch, pipe.buf_elems
+        in_size = pipe.stages[0].in_spec.size
+        ring = HostStagingRing(mb * buf, n_slots=max(4 * pipe.chunk, 16))
+        srv = _socket.create_server((host, port))
+        address = srv.getsockname()
+
+        def reader(conn):
+            try:
+                while True:
+                    kind, value = recv_frame(conn)
+                    if kind == K_END:
+                        ring.close()
+                        return
+                    assert kind == K_TENSOR
+                    x = np.asarray(value, np.float32).reshape(mb, -1)
+                    if x.shape[-1] != in_size:
+                        raise ValueError(
+                            f"sample size {x.shape[-1]} != stage-0 input "
+                            f"size {in_size}")
+                    if mb == 1:
+                        ring.push(x)  # native zero-pad to buf_elems
+                    else:
+                        row = np.zeros((mb, buf), np.float32)
+                        row[:, :in_size] = x
+                        ring.push(row)
+            except (OSError, ConnectionError):
+                ring.close()  # client vanished: drain and stop
+
+        def serve():
+            conn, _ = srv.accept()
+            conn_lock = threading.Lock()
+            threading.Thread(target=reader, args=(conn,), daemon=True,
+                             name="defer-endpoint-reader").start()
+            pipe.reset()
+            try:
+                while True:
+                    try:
+                        got, block = ring.pop_block(pipe.chunk,
+                                                    timeout_s=1.0)
+                    except TimeoutError:
+                        continue
+                    if block is None:  # END: drain the pipe
+                        for o in pipe.flush():
+                            with conn_lock:
+                                send_frame(conn, np.asarray(o, np.float32),
+                                           codec=codec)
+                        with conn_lock:
+                            send_end(conn)
+                        return
+                    outs = pipe.push(
+                        block.reshape(pipe.chunk, mb, buf), n_real=got)
+                    for o in outs:
+                        with conn_lock:
+                            send_frame(conn, np.asarray(o, np.float32),
+                                       codec=codec)
+            finally:
+                conn.close()
+                srv.close()
+
+        thread = threading.Thread(target=serve, daemon=True,
+                                  name="defer-endpoint")
+        thread.start()
+        return address, thread
+
     def run_defer(self, graph, params, cut_points,
                   input_stream: queue.Queue, output_stream: queue.Queue,
                   *, num_stages=None) -> DeferHandle:

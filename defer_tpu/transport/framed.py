@@ -139,7 +139,7 @@ class TensorServer:
 
 
 class TensorClient:
-    """Client side: stream tensors, receive results (strict request/reply)."""
+    """Client side: request/reply ``infer`` or full-duplex ``infer_stream``."""
 
     def __init__(self, host: str, port: int):
         self._sock = socket.create_connection((host, port))
@@ -151,9 +151,44 @@ class TensorClient:
             raise ConnectionError("expected tensor reply")
         return value
 
+    def infer_stream(self, arrays, *, codec: str = "raw") -> list:
+        """Pipelined streaming against a ``Defer.serve_endpoint``: sends
+        every input without waiting (keeping the remote pipeline full),
+        collects in-order replies concurrently, ends the stream, and
+        returns all results.  One call = the reference harness's whole
+        send-loop + result-server pair (reference test/test.py:39-51)."""
+        import threading
+
+        results: list[np.ndarray] = []
+        err: list[BaseException] = []
+
+        def rx():
+            try:
+                while True:
+                    kind, value = recv_frame(self._sock)
+                    if kind == K_END:
+                        return
+                    results.append(value)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                err.append(e)
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        for a in arrays:
+            send_frame(self._sock, a, codec=codec)
+        send_end(self._sock)
+        t.join(timeout=600)
+        if err:
+            raise err[0]
+        if t.is_alive():
+            raise TimeoutError("endpoint did not drain within timeout")
+        return results
+
     def close(self):
         try:
             send_end(self._sock)
             recv_frame(self._sock)
+        except (OSError, ConnectionError):
+            pass  # stream already ended / peer gone
         finally:
             self._sock.close()

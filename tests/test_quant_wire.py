@@ -72,3 +72,24 @@ def test_defer_api_wire_option():
     out = Defer(config=DeferConfig(microbatch=1, chunk=2, wire="int8")).run(
         g, p, x, num_stages=2)
     assert out.shape == (2, 1, 10)
+
+
+def test_pallas_quant_kernel_matches_jnp():
+    """One implementation contract: the Pallas kernel (interpret mode on
+    CPU) and the jnp reference produce identical payloads and scales."""
+    from defer_tpu.ops.quant import quantize_int8_blocks
+    from defer_tpu.ops.quant_pallas import quantize_int8_blocks_pallas
+
+    rng = np.random.default_rng(0)
+    for shape in [(4, 2048), (2, 3, 512), (1, 256)]:
+        x = jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 50)
+        qr, sr = quantize_int8_blocks(x, use_pallas=False)
+        qp, sp = quantize_int8_blocks_pallas(x, interpret=True)
+        np.testing.assert_array_equal(np.asarray(qr), np.asarray(qp))
+        np.testing.assert_allclose(np.asarray(sr), np.asarray(sp),
+                                   rtol=1e-7)
+    # non-finite flush behavior matches too
+    x = jnp.asarray([[np.inf, -np.inf, np.nan] + [1.0] * 253], np.float32)
+    qr, sr = quantize_int8_blocks(x, use_pallas=False)
+    qp, sp = quantize_int8_blocks_pallas(x, interpret=True)
+    np.testing.assert_array_equal(np.asarray(qr), np.asarray(qp))

@@ -1,0 +1,99 @@
+"""Native host staging ring + the network endpoint built on it.
+
+The data-plane parity tests: the reference's bounded ingest queue + socket
+loops (src/node.py:80-91,114; src/dispatcher.py:85-105) are here a C++
+ring (``_native/staging.cpp``) and one full-duplex framed endpoint.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import Defer, DeferConfig
+from defer_tpu.models import resnet_tiny
+from defer_tpu.transport.framed import TensorClient
+from defer_tpu.transport.staging import HostStagingRing, _load
+
+
+def test_native_library_builds():
+    """The C++ staging ring must actually compile here — the fallback is
+    for toolchain-less user machines, not for CI."""
+    assert _load() is not None
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_ring_push_pop_layout(native, monkeypatch):
+    if not native:
+        monkeypatch.setattr("defer_tpu.transport.staging._load",
+                            lambda: None)
+    ring = HostStagingRing(slot_elems=8, n_slots=4)
+    assert ring.is_native == native
+    ring.push(np.arange(5, dtype=np.float32))        # short: zero-padded
+    ring.push(np.arange(8, dtype=np.float32) + 100)  # exact size
+    got, block = ring.pop_block(4)
+    assert got == 2 and block.shape == (4, 8)
+    np.testing.assert_array_equal(block[0], [0, 1, 2, 3, 4, 0, 0, 0])
+    np.testing.assert_array_equal(block[1], np.arange(8) + 100)
+    np.testing.assert_array_equal(block[2:], 0)      # bubble tail
+    with pytest.raises(ValueError, match="exceeds slot"):
+        ring.push(np.zeros(9, np.float32))
+
+
+def test_ring_close_drain_and_timeout():
+    ring = HostStagingRing(slot_elems=4, n_slots=2)
+    ring.push(np.ones(4, np.float32))
+    ring.close()
+    got, block = ring.pop_block(2)
+    assert got == 1                       # backlog drains after close
+    got, block = ring.pop_block(2)
+    assert got == 0 and block is None     # then end-of-stream
+    with pytest.raises(ValueError, match="closed"):
+        ring.push(np.ones(4, np.float32))
+    ring2 = HostStagingRing(slot_elems=4, n_slots=2)
+    with pytest.raises(TimeoutError):
+        ring2.pop_block(1, timeout_s=0.05)
+
+
+def test_ring_backpressure_blocks_producer():
+    """A full ring blocks push (bounded in-flight window) until the
+    consumer drains — and the block is bounded, not forever."""
+    ring = HostStagingRing(slot_elems=4, n_slots=2)
+    assert ring.push(np.ones(4, np.float32), timeout_s=1.0)
+    assert ring.push(np.ones(4, np.float32), timeout_s=1.0)
+    t0 = time.perf_counter()
+    assert not ring.push(np.ones(4, np.float32), timeout_s=0.2)  # timeout
+    assert 0.15 < time.perf_counter() - t0 < 5.0
+
+    def drain():
+        time.sleep(0.2)
+        ring.pop_block(2)
+
+    threading.Thread(target=drain, daemon=True).start()
+    assert ring.push(np.ones(4, np.float32), timeout_s=5.0)  # unblocked
+
+
+def test_serve_endpoint_streams_in_order():
+    """Full-duplex endpoint: framed tensors in, pipelined results out, all
+    in feed order, vs the single-program oracle."""
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=4))
+    address, thread = defer.serve_endpoint(g, params, num_stages=4)
+
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+          for _ in range(10)]
+    client = TensorClient(*address)
+    outs = client.infer_stream(xs)
+    client.close()
+    thread.join(timeout=60)
+
+    assert len(outs) == 10
+    fwd = jax.jit(g.apply)
+    for x, y in zip(xs, outs):
+        np.testing.assert_allclose(y, np.asarray(fwd(params, x)),
+                                   rtol=2e-4, atol=2e-4)
