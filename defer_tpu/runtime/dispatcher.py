@@ -48,6 +48,9 @@ class DeferHandle:
         #: completed dispatches; the watchdog only arms after the first one
         #: so jit compilation time is never mistaken for a hang
         self._dispatches: int = 0
+        #: slowest completed dispatch (seconds) — scales the watchdog
+        #: threshold so legitimately slow deployments never false-positive
+        self._max_dispatch_s: float = 0.0
 
     def stop(self):
         self._stop.set()
@@ -186,7 +189,8 @@ class Defer:
 
     def serve_endpoint(self, graph, params, cut_points=None, *,
                        num_stages=None, host: str = "127.0.0.1",
-                       port: int = 0, codec: str = "raw"):
+                       port: int = 0, codec: str = "raw",
+                       stall_timeout_s: float = 120.0):
         """Network front door: accept framed tensors, stream them through
         the pipeline via the native staging ring, reply in order.
 
@@ -215,6 +219,11 @@ class Defer:
         srv = _socket.create_server((host, port))
         address = srv.getsockname()
 
+        #: first error from either thread; a non-empty list aborts the
+        #: connection WITHOUT the END frame so the client fails loudly
+        #: (never a silently short result stream)
+        errors: list[BaseException] = []
+
         def reader(conn):
             try:
                 while True:
@@ -222,20 +231,31 @@ class Defer:
                     if kind == K_END:
                         ring.close()
                         return
-                    assert kind == K_TENSOR
+                    if kind != K_TENSOR:
+                        raise ConnectionError(
+                            f"unexpected frame kind {kind!r} on the "
+                            f"endpoint's input stream")
                     x = np.asarray(value, np.float32).reshape(mb, -1)
                     if x.shape[-1] != in_size:
                         raise ValueError(
                             f"sample size {x.shape[-1]} != stage-0 input "
                             f"size {in_size}")
                     if mb == 1:
-                        ring.push(x)  # native zero-pad to buf_elems
+                        row = x  # native zero-pad to buf_elems
                     else:
                         row = np.zeros((mb, buf), np.float32)
                         row[:, :in_size] = x
-                        ring.push(row)
-            except (OSError, ConnectionError):
-                ring.close()  # client vanished: drain and stop
+                    # a full ring is normal backpressure (client ahead of
+                    # the pipeline); a ring still full after the stall
+                    # timeout means the pipeline stopped draining — fail
+                    # loudly, never silently drop the sample
+                    if not ring.push(row, timeout_s=stall_timeout_s):
+                        raise RuntimeError(
+                            f"staging ring full for {stall_timeout_s:.0f}s "
+                            f"— pipeline stalled; sample would be dropped")
+            except BaseException as e:  # noqa: BLE001 — any reader death
+                errors.append(e)        # must unwedge the serve loop
+                ring.close()
 
         def serve():
             conn, _ = srv.accept()
@@ -249,8 +269,12 @@ class Defer:
                         got, block = ring.pop_block(pipe.chunk,
                                                     timeout_s=1.0)
                     except TimeoutError:
+                        if errors:
+                            return  # reader died; abort without END
                         continue
-                    if block is None:  # END: drain the pipe
+                    if block is None:  # END (or reader error): drain
+                        if errors:
+                            return  # abort: reset-close, no END frame
                         for o in pipe.flush():
                             with conn_lock:
                                 send_frame(conn, np.asarray(o, np.float32),
@@ -259,17 +283,22 @@ class Defer:
                             send_end(conn)
                         return
                     outs = pipe.push(
-                        block.reshape(pipe.chunk, mb, buf), n_real=got)
+                        block.reshape(pipe.chunk, mb, buf), n_real=got,
+                        staged=True)
                     for o in outs:
                         with conn_lock:
                             send_frame(conn, np.asarray(o, np.float32),
                                        codec=codec)
+            except BaseException as e:  # noqa: BLE001 — surfaced on .errors
+                errors.append(e)
+                raise
             finally:
                 conn.close()
                 srv.close()
 
         thread = threading.Thread(target=serve, daemon=True,
                                   name="defer-endpoint")
+        thread.errors = errors  # inspectable post-join
         thread.start()
         return address, thread
 
@@ -299,13 +328,16 @@ class Defer:
             # arm=False exempts dispatches that may legitimately block for
             # an XLA compile (new input shape in MPMD mode) — a compile is
             # not a hang, however long it takes.
+            t0 = time.monotonic()
             if arm:
-                handle._busy_since = time.monotonic()
+                handle._busy_since = t0
             try:
                 out = fn(*a, **kw)
             finally:
                 handle._busy_since = None
             handle._dispatches += 1
+            handle._max_dispatch_s = max(handle._max_dispatch_s,
+                                         time.monotonic() - t0)
             return out
 
         def _serve_inner():
@@ -411,9 +443,14 @@ class Defer:
 
         if cfg.watchdog_s is not None:
             def watch():
-                wd = cfg.watchdog_s
                 while not stop.is_set() and thread.is_alive():
                     busy = handle._busy_since
+                    # threshold self-scales to the slowest dispatch this
+                    # deployment has actually completed (compile included):
+                    # big-chunk slow-host dispatches raise their own bound
+                    # instead of being declared dead at a fixed 60 s
+                    wd = max(cfg.watchdog_s,
+                             cfg.watchdog_scale * handle._max_dispatch_s)
                     # unarmed until one dispatch completed: the first call
                     # legitimately blocks for the whole jit compile
                     if (handle._dispatches > 0 and busy is not None

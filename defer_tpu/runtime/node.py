@@ -156,16 +156,23 @@ class ChainDispatcher:
             send_frame(self._send_sock, np.asarray(x), codec=self.codec)
             in_flight += 1
             if in_flight >= self.window:
-                kind, y = recv_frame(self._res_conn)
-                assert kind == K_TENSOR
-                outs.append(y)
+                outs.append(self._recv_tensor())
                 in_flight -= 1
         while in_flight:
-            kind, y = recv_frame(self._res_conn)
-            assert kind == K_TENSOR
-            outs.append(y)
+            outs.append(self._recv_tensor())
             in_flight -= 1
         return outs
+
+    def _recv_tensor(self) -> np.ndarray:
+        """One in-order result frame; loud protocol check (not an assert:
+        ``python -O`` strips asserts, and an early END from a node that died
+        mid-stream must raise, not silently mis-drain)."""
+        kind, y = recv_frame(self._res_conn)
+        if kind != K_TENSOR:
+            raise ConnectionError(
+                f"chain returned frame kind {kind!r} while results were "
+                f"still in flight (a stage node died and cascaded END?)")
+        return y
 
     def close(self):
         """Drain the chain (best effort) and close every socket.

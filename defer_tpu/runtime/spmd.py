@@ -324,15 +324,22 @@ class SpmdPipeline:
         self._real: collections.deque[bool] = collections.deque()
         self._emitted = 0
 
-    def _flatten_inputs(self, xs) -> jax.Array:
+    def _flatten_inputs(self, xs, staged: bool = False) -> jax.Array:
         if (isinstance(xs, jax.Array) and xs.ndim == 3
                 and xs.shape[1:] == (self.microbatch, self.buf_elems)
                 and xs.dtype == self.buffer_dtype):
             return xs  # already staged via stage_inputs()
-        if (isinstance(xs, np.ndarray) and xs.ndim == 3
-                and xs.shape[1:] == (self.microbatch, self.buf_elems)):
+        if staged:
             # host block already in transfer-buffer layout (e.g. drained
-            # from the native staging ring): one straight device copy
+            # from the native staging ring): one straight device copy.
+            # Opt-in only — a mis-shaped user input that coincidentally
+            # matched [C, microbatch, buf_elems] must NOT skip validation.
+            xs = np.asarray(xs)
+            if xs.ndim != 3 or xs.shape[1:] != (self.microbatch,
+                                                self.buf_elems):
+                raise ValueError(
+                    f"staged block must be [C, {self.microbatch}, "
+                    f"{self.buf_elems}], got {xs.shape}")
             return jax.device_put(xs.astype(self.buffer_dtype, copy=False),
                                   self._xs_sharding)
         c = xs.shape[0]
@@ -355,19 +362,23 @@ class SpmdPipeline:
         reuses one device tensor per predict call)."""
         return self._flatten_inputs(np.asarray(xs))
 
-    def push(self, xs: np.ndarray, n_real: int | None = None):
+    def push(self, xs: np.ndarray, n_real: int | None = None, *,
+             staged: bool = False):
         """Advance the pipe by ``xs.shape[0]`` steps, feeding ``xs``.
 
         ``xs``: [C, microbatch, *in_shape] host array, or a device block
         from ``stage_inputs``.  ``n_real`` marks how many leading entries
-        are real inputs (the rest are bubble padding).
-        Returns the list of completed output microbatches (jax arrays of
-        shape [microbatch, *out_shape]), in feed order.
+        are real inputs (the rest are bubble padding).  ``staged=True``
+        declares a host block already in transfer-buffer layout
+        ``[C, microbatch, buf_elems]`` (e.g. drained from the native
+        staging ring) — the explicit opt-in for skipping per-sample size
+        validation.  Returns the list of completed output microbatches
+        (jax arrays of shape [microbatch, *out_shape]), in feed order.
         """
         c = xs.shape[0]
         if n_real is None:
             n_real = c
-        xs_dev = self._flatten_inputs(xs)
+        xs_dev = self._flatten_inputs(xs, staged=staged)
         t0 = time.perf_counter()
         self._a, outs = self._chunk_fn(self._w, self._a, xs_dev)
         self.metrics.chunk_calls += 1

@@ -44,11 +44,18 @@ class DeferConfig:
     # pipeline dispatch makes no progress for this many seconds the serve
     # thread is declared dead and readers unblocked (the reference has no
     # failure handling at all — a dead node hangs the chain forever,
-    # SURVEY.md §5; None disables).  On by default with a generous bound:
-    # steady-state dispatches are milliseconds, and the first (compile)
-    # dispatch never arms the watchdog, so 60 s only ever fires on a dead
-    # device/backend.
+    # SURVEY.md §5; None disables).  The effective bound self-scales to the
+    # deployment: max(watchdog_s, watchdog_scale * slowest completed
+    # dispatch so far) — so a slow host whose legitimate dispatches take
+    # tens of seconds (big chunk on the CPU fallback, device-shape
+    # recompiles) raises its own threshold instead of being falsely
+    # declared dead, while a genuinely wedged dispatch still fires in
+    # bounded time.
     watchdog_s: float | None = 60.0
+    # multiplier on the slowest completed dispatch (warmup/preflight
+    # included — it covers the XLA compile, the natural upper bound for
+    # any later legitimate dispatch)
+    watchdog_scale: float = 8.0
     # run a full-chunk bubble probe through the freshly built pipeline
     # before serving traffic, so compile failures surface as handle.error
     # immediately instead of mid-stream

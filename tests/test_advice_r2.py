@@ -1,0 +1,141 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md r2).
+
+Each test fails on the pre-fix code:
+
+1. serve_endpoint silently dropped samples when the staging ring stayed
+   full past push's timeout (dispatcher.py — push return ignored).
+2. serve_endpoint's reader thread died on a bad-size sample without closing
+   the ring, wedging the serve loop and hanging the client to its cap.
+3. ChainDispatcher.stream validated frames with bare asserts (stripped
+   under ``python -O``); an early END mis-drained instead of raising.
+4. SpmdPipeline treated any [C, microbatch, buf_elems] numpy array as
+   pre-staged, bypassing input-size validation.
+5. The watchdog's fixed 60 s default falsely declared legitimately slow
+   deployments dead; it now scales to the slowest completed dispatch.
+"""
+
+import queue
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import Defer, DeferConfig, END_OF_STREAM
+from defer_tpu.models import resnet_tiny
+from defer_tpu.partition.partitioner import partition
+from defer_tpu.runtime.node import ChainDispatcher
+from defer_tpu.runtime.spmd import SpmdPipeline
+from defer_tpu.transport.framed import TensorClient, send_end
+from defer_tpu.transport.staging import HostStagingRing
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    g = resnet_tiny()
+    return g, g.init(jax.random.key(0))
+
+
+def test_endpoint_ring_stall_fails_loudly(tiny, monkeypatch):
+    """ADVICE r2 #1: a ring that never accepts (pipeline stalled) must abort
+    the connection, not silently return fewer results than inputs."""
+    g, params = tiny
+    monkeypatch.setattr(HostStagingRing, "push",
+                        lambda self, sample, timeout_s=30.0: False)
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2))
+    address, thread = defer.serve_endpoint(g, params, num_stages=2,
+                                           stall_timeout_s=0.2)
+    client = TensorClient(*address)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    with pytest.raises((OSError, ConnectionError)):
+        client.infer_stream([x, x])
+    thread.join(timeout=30)
+    assert not thread.is_alive()
+    assert any(isinstance(e, RuntimeError) for e in thread.errors)
+
+
+def test_endpoint_bad_sample_aborts_connection(tiny):
+    """ADVICE r2 #2: a wrong-size sample must kill the stream with an error
+    in bounded time — the reader's ValueError used to leak, leaving the
+    serve loop spinning and the client hanging to its 600 s cap."""
+    g, params = tiny
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2))
+    address, thread = defer.serve_endpoint(g, params, num_stages=2)
+    client = TensorClient(*address)
+    t0 = time.monotonic()
+    with pytest.raises((OSError, ConnectionError)):
+        client.infer_stream([np.zeros((1, 7), np.float32)])
+    assert time.monotonic() - t0 < 60
+    thread.join(timeout=30)
+    assert not thread.is_alive()
+    assert any(isinstance(e, ValueError) for e in thread.errors)
+
+
+def test_chain_stream_early_end_raises_connectionerror():
+    """ADVICE r2 #3: an END frame where a result tensor is due (a stage node
+    died and cascaded END) must raise ConnectionError — explicitly, not via
+    an ``assert`` that ``python -O`` strips."""
+    send_sock, chain_in = socket.socketpair()
+    res_conn, chain_out = socket.socketpair()
+    cd = ChainDispatcher.__new__(ChainDispatcher)
+    cd._send_sock = send_sock
+    cd._res_conn = res_conn
+    cd.codec = "raw"
+    cd.window = 1
+    send_end(chain_out)  # the dead chain's cascaded END
+    with pytest.raises(ConnectionError, match="still in flight"):
+        cd.stream([np.zeros((1, 4), np.float32),
+                   np.zeros((1, 4), np.float32)])
+    for s in (send_sock, chain_in, res_conn, chain_out):
+        s.close()
+
+
+def test_unstaged_buffer_shaped_input_rejected(tiny):
+    """ADVICE r2 #4: a numpy block that merely *looks* like the transfer
+    buffer ([C, microbatch, buf_elems]) must still be size-validated; only
+    the explicit ``staged=True`` opt-in (or a device block from
+    ``stage_inputs``) may skip it."""
+    from defer_tpu.models import vgg_tiny
+    g = vgg_tiny()
+    params = g.init(jax.random.key(0))
+    pipe = SpmdPipeline(partition(g, num_stages=4), params,
+                        microbatch=1, chunk=2)
+    in_size = pipe.stages[0].in_spec.size
+    assert pipe.buf_elems != in_size  # precondition: shapes distinguishable
+    block = np.zeros((2, 1, pipe.buf_elems), np.float32)
+    with pytest.raises(ValueError, match="stage-0 input"):
+        pipe.push(block)
+    pipe.reset()
+    assert isinstance(pipe.push(block, staged=True), list)  # opt-in works
+    with pytest.raises(ValueError, match="staged block"):
+        pipe.push(np.zeros((2, 1, pipe.buf_elems + 1), np.float32),
+                  staged=True)
+
+
+def test_watchdog_scales_to_slow_dispatches(tiny, monkeypatch):
+    """ADVICE r2 #5: dispatches legitimately slower than watchdog_s (big
+    chunk on a slow host) must not be declared dead — the threshold scales
+    to the slowest completed dispatch instead of a fixed cutoff."""
+    g, params = tiny
+    orig_push = SpmdPipeline.push
+
+    def slow_push(self, *a, **kw):
+        time.sleep(0.6)  # every dispatch ~3x the configured watchdog
+        return orig_push(self, *a, **kw)
+
+    monkeypatch.setattr(SpmdPipeline, "push", slow_push)
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2, watchdog_s=0.2))
+    in_q, out_q = queue.Queue(), queue.Queue()
+    h = defer.run_defer(g, params, None, in_q, out_q, num_stages=2)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    in_q.put(x)
+    in_q.put(x)
+    in_q.put(END_OF_STREAM)
+    h.join(timeout=120)  # raises RuntimeError if the watchdog misfired
+    assert h.healthy
+    outs = []
+    while not out_q.empty():
+        outs.append(out_q.get())
+    assert len(outs) == 2 and all(o is not None for o in outs)
