@@ -3,8 +3,26 @@
 Mirrors the reference's measurement protocol — timed-window throughput of
 batch-1 streaming inference (reference test/test.py:25-37) against a
 single-device predict loop (reference test/local_infer.py:16-23) — and adds
-what the reference never measured: a batch sweep (1/8/32) and model FLOPs
-utilisation (graph FLOPs / step time / chip peak).
+what the reference never measured: a batch sweep, amortized-dispatch
+numbers, and model FLOPs utilisation (graph FLOPs / step time / chip peak).
+
+Measurement design (r4).  This chip sits behind a tunnel whose per-sync
+round trip is ~76 ms (PROFILE_r04.md), so per-step dispatch+sync — the r3
+protocol — measures the tunnel, not the chip.  Each side is therefore
+reported two ways:
+
+  * single-chip ``stepwise``: dispatch + block per step (reference
+    local_infer protocol, kept for parity/continuity), and
+    ``scan``: K forwards fused in one on-device ``lax.scan`` dispatch —
+    the chip's true best single-program throughput.  The HONEST baseline
+    (``vs_baseline`` denominator) is the best scan number across batch
+    sizes, NOT the weak batch-1 stepwise number r3 divided by.
+  * pipeline: swept over (chunk, microbatch) with >=2 chunks in flight
+    (no per-chunk sync) and whole-chunk result slabs drained to host
+    (``SpmdPipeline.push(raw=True)``).
+
+Both sides keep their input device-resident, mirroring the reference
+harness re-feeding one image (test/test.py:20-23).
 
 Device handling: this environment reaches its single TPU chip through a
 tunnel that admits one client and can wedge indefinitely if a previous
@@ -133,29 +151,25 @@ def init_devices():
               [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
 
 
-def timed_window(fn, *, min_iters=8, min_s=3.0, max_iters=512):
-    """Warm call, then measure average seconds/iter over a timed window."""
-    fn()  # warmup / compile
-    t0 = time.perf_counter()
-    n = 0
-    while True:
-        fn()
-        n += 1
-        dt = time.perf_counter() - t0
-        if (n >= min_iters and dt >= min_s) or n >= max_iters:
-            return dt / n
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--weights", default=None,
                     help="path to a pretrained ResNet50 checkpoint "
                          "(npz/safetensors; see defer_tpu.utils.pretrained)")
-    ap.add_argument("--batches", default="1,8,32",
+    ap.add_argument("--batches", default="1,8,32,128",
                     help="baseline batch sweep sizes (TPU only)")
+    ap.add_argument("--chunks", default="32,128,512",
+                    help="pipeline chunk sweep (steps fused per dispatch)")
+    ap.add_argument("--microbatches", default="1,8,32",
+                    help="pipeline microbatch sweep")
+    ap.add_argument("--quick", action="store_true",
+                    help="small sweep: batches 1,32; one pipeline config")
     args = ap.parse_args()
 
     devices = init_devices()
+
+    import collections
+    import math
 
     import jax
     import jax.numpy as jnp
@@ -175,15 +189,17 @@ def main():
         graph = resnet50()
         in_shape = (224, 224, 3)
         compute_dtype = jnp.bfloat16
-        chunk = 32
-        # batch 1 always measured: it is the vs_baseline denominator
         batches = sorted({1, *(int(b) for b in args.batches.split(","))})
+        chunks = [int(c) for c in args.chunks.split(",")]
+        mbs = [int(m) for m in args.microbatches.split(",")]
+        if args.quick:
+            batches, chunks, mbs = [1, 32], [128], [8]
     else:  # CI / local smoke: small model, same code path
         graph = resnet_tiny()
         in_shape = (32, 32, 3)
         compute_dtype = None
-        chunk = 8
         batches = [1]
+        chunks, mbs = [8], [1]
 
     if args.weights and on_tpu:
         from defer_tpu.utils.pretrained import load_pretrained_resnet50
@@ -205,23 +221,43 @@ def main():
         params_c = params
     x_dtype = compute_dtype or jnp.float32
 
+    def mfu(ips):
+        return round(flops_img * ips / peak, 4) if (on_tpu and peak > 0) \
+            else None
+
+    from defer_tpu.utils.profiling import (amortized_forward_seconds,
+                                           timed_window)
+
+    def scan_step_seconds(b, k):
+        """Per-forward seconds with K forwards fused in ONE dispatch."""
+        x0 = jnp.zeros((b,) + in_shape, x_dtype)
+        return amortized_forward_seconds(graph.apply, params_c, x0, k)
+
     sweep = {}
+    single_best_ips = 0.0
     for b in batches:
         xb = jnp.zeros((b,) + in_shape, x_dtype)
         sec = timed_window(lambda: jax.block_until_ready(fwd(params_c, xb)))
-        ips = b / sec
+        k = 64 if b <= 8 else (32 if b <= 64 else 16)
+        scan_sec = scan_step_seconds(b, k)
         entry = {
-            "img_per_s": round(ips, 2),
+            "img_per_s": round(b / sec, 2),
             "ms_per_img": round(1e3 * sec / b, 4),
             "ms_per_step": round(1e3 * sec, 4),
+            "scan_img_per_s": round(b / scan_sec, 2),
+            "scan_ms_per_step": round(1e3 * scan_sec, 4),
         }
         if on_tpu and peak > 0:
-            entry["mfu"] = round(flops_img * ips / peak, 4)
+            entry["mfu"] = mfu(b / sec)
+            entry["scan_mfu"] = mfu(b / scan_sec)
         sweep[b] = entry
-        log(f"single-chip batch {b}: {ips:.2f} img/s "
-            f"({1e3 * sec / b:.3f} ms/img"
-            + (f", MFU {entry['mfu']:.1%})" if "mfu" in entry else ")"))
-    single_ips = sweep[1]["img_per_s"]
+        single_best_ips = max(single_best_ips, b / scan_sec)
+        log(f"single-chip batch {b}: stepwise {b / sec:.2f} img/s "
+            f"({1e3 * sec:.2f} ms/step) | scan x{k} "
+            f"{b / scan_sec:.2f} img/s ({1e3 * scan_sec:.3f} ms/step"
+            + (f", MFU {entry['scan_mfu']:.1%})" if "scan_mfu" in entry
+               else ")"))
+    single_stepwise_b1 = sweep[batches[0]]["img_per_s"]
 
     # ---- pipelined inference over all devices (test/test.py protocol)
     num_stages = n
@@ -230,33 +266,155 @@ def main():
                            num_stages=None if on_tpu else 8)
     else:
         stages = partition(graph, num_stages=num_stages)
-    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(num_stages),
-                        microbatch=1, chunk=chunk,
-                        buffer_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-                        compute_dtype=compute_dtype)
-    # pre-stage the input block on device, mirroring the baseline's resident
-    # input tensor (the reference harness also re-feeds one image,
-    # test/test.py:20-23)
-    inputs = pipe.stage_inputs(np.zeros((chunk, 1) + in_shape, np.float32))
+    buffer_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    buf_elems = max([s.in_spec.size for s in stages]
+                    + [s.out_spec.size for s in stages])
+    mem_cap = 2.5e9  # device bytes allowed for the resident input block
 
-    def run_chunk():
-        pipe.push(inputs)
-        jax.block_until_ready(pipe._a)
+    def bench_pipe(chunk, mb, wire="buffer"):
+        """(pipe, img_per_s, sec_per_chunk) with >=2 chunks in flight."""
+        pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(num_stages),
+                            microbatch=mb, chunk=chunk,
+                            buffer_dtype=buffer_dtype,
+                            compute_dtype=compute_dtype, wire=wire)
+        inputs = pipe.stage_inputs(
+            np.zeros((chunk, mb) + in_shape, np.float32))
+        # warm-compile by pushing the resident input block as bubbles
+        # instead of pipe.warmup(): warmup would cache a SECOND chunk-sized
+        # bubble block on device, doubling the footprint the mem_cap guard
+        # accounts for
+        pipe.reset()
+        slab, _ = pipe.push(inputs, n_real=0, raw=True)
+        if slab is not None:
+            np.asarray(slab)
+        pipe.reset()
 
-    pipe.warmup()
-    sec_chunk = timed_window(run_chunk)
-    pipe_ips = chunk / sec_chunk
-    pipe_mfu = flops_img * pipe_ips / peak if (on_tpu and peak > 0) else None
-    log(f"pipeline ({num_stages} stage{'s' if num_stages > 1 else ''}): "
-        f"{pipe_ips:.2f} img/s steady-state, buffer {pipe.buf_elems} "
-        f"elems/hop" + (f", MFU {pipe_mfu:.1%}" if pipe_mfu else ""))
+        def run_window(m_chunks):
+            # no per-chunk sync: keep two chunk dispatches in flight and
+            # drain each completed chunk's result slab to the host (the
+            # reference harness also counts only results that arrived,
+            # test/test.py:29-37)
+            pending = collections.deque()
+            t0 = time.perf_counter()
+            for _ in range(m_chunks):
+                slab, _mask = pipe.push(inputs, raw=True)
+                if slab is not None:
+                    pending.append(slab)
+                while len(pending) > 2:
+                    np.asarray(pending.popleft())
+            while pending:
+                np.asarray(pending.popleft())
+            return time.perf_counter() - t0
+
+        run_window(2)  # post-compile warm pass
+        t1 = max(run_window(1), 1e-4)
+        m = max(2, min(64, math.ceil(2.5 / t1)))
+        sec = run_window(m) / m
+        return pipe, chunk * mb / sec, sec
+
+    pipe_sweep = {}
+    best = None  # (ips, chunk, mb, pipe)
+    for chunk in chunks:
+        for mb in mbs:
+            need = chunk * mb * buf_elems * jnp.dtype(buffer_dtype).itemsize
+            if need > mem_cap:
+                log(f"pipeline chunk={chunk} mb={mb}: SKIPPED "
+                    f"(resident input block {need / 1e9:.1f} GB > cap)")
+                pipe_sweep[f"c{chunk}_m{mb}"] = {"skipped": "memory"}
+                continue
+            pipe, ips, sec = bench_pipe(chunk, mb)
+            entry = {"img_per_s": round(ips, 2),
+                     "ms_per_chunk": round(sec * 1e3, 2),
+                     "ms_per_step": round(sec * 1e3 / chunk, 4)}
+            if on_tpu and peak > 0:
+                entry["mfu"] = mfu(ips)
+            pipe_sweep[f"c{chunk}_m{mb}"] = entry
+            log(f"pipeline chunk={chunk} mb={mb}: {ips:.2f} img/s"
+                + (f" (MFU {entry['mfu']:.1%})" if entry.get("mfu") else ""))
+            if best is None or ips > best[0]:
+                best = (ips, chunk, mb, pipe)
+    if best is None:
+        # every swept config hit the memory cap: clamp the smallest one
+        # DOWN to the cap (never run over it) so the bench always emits
+        # its JSON line without risking the OOM the cap guards against
+        mb = min(mbs)
+        itemsize = jnp.dtype(buffer_dtype).itemsize
+        chunk = max(2, int(mem_cap // (mb * buf_elems * itemsize)))
+        log(f"pipeline: all configs over mem cap; clamped to chunk={chunk} "
+            f"mb={mb}")
+        pipe, ips, _sec = bench_pipe(chunk, mb)
+        pipe_sweep[f"c{chunk}_m{mb}"] = {"img_per_s": round(ips, 2),
+                                         "forced": True}
+        best = (ips, chunk, mb, pipe)
+    pipe_ips, best_chunk, best_mb, pipe = best
+
+    # per-stage latency -> duty cycle / bubble metrics on the best config
+    try:
+        pipe.stage_latencies(iters=3)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill bench
+        log(f"bench: stage_latencies failed: {e!r}")
+    deploy_metrics = pipe.metrics.as_dict()
+
+    # ---- int8 wire (the device-side ZFP analogue) on the best config
+    int8_row = None
+    if on_tpu:
+        try:
+            qpipe, q_ips, _ = bench_pipe(best_chunk, best_mb, wire="int8")
+            del qpipe  # throughput only; accuracy below on small pipes
+            # accuracy: int8 wire vs the bf16 buffer wire actually deployed
+            # above AND vs an exact f32 single-program forward, on small
+            # dedicated pipes (the big config's run()/flush() would stage
+            # another chunk-sized bubble block on device)
+            acc = {}
+            x_acc = np.random.default_rng(0).standard_normal(
+                (4, 1) + in_shape).astype(np.float32)
+            y_ref = np.stack([np.asarray(
+                fwd(params, jnp.asarray(x)), np.float32) for x in x_acc])
+            for w in ("buffer", "int8"):
+                p_small = SpmdPipeline(
+                    stages, params, mesh=pipeline_mesh(num_stages),
+                    microbatch=1, chunk=4, buffer_dtype=buffer_dtype,
+                    compute_dtype=compute_dtype, wire=w)
+                acc[w] = p_small.run(x_acc)
+                del p_small
+            denom = max(float(np.abs(y_ref).max()), 1e-6)
+            int8_row = {
+                "img_per_s": round(q_ips, 2),
+                "mfu": mfu(q_ips),
+                "vs_buffer_wire": round(q_ips / pipe_ips, 4),
+                # buffer wire is bf16 on TPU — both deltas are vs the exact
+                # f32 single-program logits so they are comparable
+                "max_abs_logit_err_vs_f32": round(
+                    float(np.abs(acc["int8"] - y_ref).max()), 5),
+                "bf16_buffer_max_abs_logit_err_vs_f32": round(
+                    float(np.abs(acc["buffer"] - y_ref).max()), 5),
+                "rel_logit_err": round(
+                    float(np.abs(acc["int8"] - y_ref).max()) / denom, 5),
+            }
+            log(f"pipeline int8 wire: {q_ips:.2f} img/s "
+                f"({int8_row['vs_buffer_wire']:.2f}x buffer wire), "
+                f"rel logit err {int8_row['rel_logit_err']:.4f} "
+                f"(bf16 wire err "
+                f"{int8_row['bf16_buffer_max_abs_logit_err_vs_f32']})")
+        except Exception as e:  # noqa: BLE001 — optional row
+            log(f"bench: int8 wire measurement failed: {e!r}")
+            int8_row = {"error": repr(e)[:200]}
+
+    # ---- padded-buffer waste: what each hop actually carries vs buf_elems
+    hop_elems = [s.out_spec.size for s in stages]  # hop k = stage k's output
+    buffer_util = [round(h / pipe.buf_elems, 4) for h in hop_elems]
 
     model = "resnet50" if on_tpu else "resnet_tiny"
     result = {
         "metric": f"{model}_{num_stages}stage_pipeline_throughput",
         "value": round(pipe_ips, 3),
         "unit": "inferences/sec",
-        "vs_baseline": round(pipe_ips / single_ips, 4),
+        # HONEST baseline: the chip's best single-program throughput (scan-
+        # amortized, best batch) — r3 divided by the weak batch-1 stepwise
+        # number and reported 19.9x; see VERDICT r3 weakness #3
+        "vs_baseline": round(pipe_ips / single_best_ips, 4),
+        "vs_stepwise_batch1": round(pipe_ips / single_stepwise_b1, 4),
+        "single_chip_best_img_per_s": round(single_best_ips, 2),
         "platform": platform,
         "device_kind": str(getattr(devices[0], "device_kind", "")),
         "tpu_generation": gen if on_tpu else None,
@@ -264,10 +422,20 @@ def main():
         "compute_dtype": "bfloat16" if compute_dtype is not None else "float32",
         "flops_per_img": flops_img,
         "batch_sweep": {str(k): v for k, v in sweep.items()},
+        "pipeline_sweep": pipe_sweep,
+        "pipeline_best": {"chunk": best_chunk, "microbatch": best_mb,
+                          "img_per_s": round(pipe_ips, 2)},
+        "deploy_metrics": deploy_metrics,
+        "buffer_utilization_per_hop": buffer_util,
+        "buffer_elems": pipe.buf_elems,
     }
-    if pipe_mfu is not None:
-        result["mfu_pipeline_batch1"] = round(pipe_mfu, 4)
-        result["mfu_best"] = max(v.get("mfu", 0.0) for v in sweep.values())
+    if int8_row is not None:
+        result["int8_wire"] = int8_row
+    if on_tpu and peak > 0:
+        result["mfu_pipeline_best"] = mfu(pipe_ips)
+        result["mfu_best"] = max(
+            [mfu(pipe_ips) or 0.0, mfu(single_best_ips) or 0.0]
+            + [v.get("scan_mfu") or 0.0 for v in sweep.values()])
     print(json.dumps(result))
 
 

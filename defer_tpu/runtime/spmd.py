@@ -363,7 +363,7 @@ class SpmdPipeline:
         return self._flatten_inputs(np.asarray(xs))
 
     def push(self, xs: np.ndarray, n_real: int | None = None, *,
-             staged: bool = False):
+             staged: bool = False, raw: bool = False):
         """Advance the pipe by ``xs.shape[0]`` steps, feeding ``xs``.
 
         ``xs``: [C, microbatch, *in_shape] host array, or a device block
@@ -374,6 +374,12 @@ class SpmdPipeline:
         staging ring) — the explicit opt-in for skipping per-sample size
         validation.  Returns the list of completed output microbatches
         (jax arrays of shape [microbatch, *out_shape]), in feed order.
+
+        ``raw=True`` returns ``(slab, real_mask)`` instead: one lazy device
+        array ``[n_completed, microbatch, out_size]`` of every microbatch
+        that completed this chunk (bubbles included) plus a bool mask of
+        which entries are real.  One device slice per chunk instead of one
+        per step — the hot-path drain for benchmarks and bulk serving.
         """
         c = xs.shape[0]
         if n_real is None:
@@ -386,11 +392,11 @@ class SpmdPipeline:
         self._real.extend([True] * n_real + [False] * (c - n_real))
         self._fed += c
 
-        ready = self._collect(outs, c)
+        ready = self._collect(outs, c, raw=raw)
         self.metrics.wall_s += time.perf_counter() - t0
         return ready
 
-    def _collect(self, outs, c: int):
+    def _collect(self, outs, c: int, raw: bool = False):
         """Map step outputs back to microbatch indices and drop bubbles."""
         n = self.num_stages
         out_shape = (self.microbatch,) + self.out_spec.shape
@@ -398,24 +404,32 @@ class SpmdPipeline:
         # "the dispatcher" each step (reference src/dispatcher.py:102-105);
         # the scan body already cropped it to the final stage's output size
         outs0 = outs[0]
-        ready = []
-        for j in range(c):
-            s = self._step + j          # global step index
-            m = s - (n - 1)             # microbatch completing at step s
-            if m < 0 or m >= self._fed:
-                continue
-            ready.append((m, outs0[j].reshape(out_shape)))
+        # steps j in this chunk completing a microbatch m = _step+j-(n-1)
+        # with 0 <= m < _fed form one contiguous local range [j0, j1)
+        j0 = max(0, (n - 1) - self._step)
+        j1 = min(c, self._fed + (n - 1) - self._step)
+        cnt = max(0, j1 - j0)
+        if cnt:  # outputs complete strictly in feed order
+            assert self._step + j0 - (n - 1) == self._emitted, \
+                (self._step, j0, n, self._emitted)
         self._step += c
 
+        if raw:
+            mask = np.empty(cnt, bool)
+            for i in range(cnt):
+                mask[i] = self._real.popleft()
+            self._emitted += cnt
+            self.metrics.inferences += int(mask.sum()) * self.microbatch
+            slab = outs0[j0:j1] if cnt else None  # lazy: ONE device slice
+            return slab, mask
+
         emitted = []
-        for m, arr in ready:
-            # outputs complete strictly in feed order; pop real-ness flags
-            assert m == self._emitted, (m, self._emitted)
+        for j in range(j0, j1):
             is_real = self._real.popleft()
             self._emitted += 1
             if is_real:
                 self.metrics.inferences += self.microbatch
-                emitted.append(arr)
+                emitted.append(outs0[j].reshape(out_shape))
         return emitted
 
     def _bubble_block(self) -> jax.Array:

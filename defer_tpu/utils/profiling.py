@@ -16,6 +16,51 @@ import jax
 import numpy as np
 
 
+def timed_window(fn, *, min_iters=8, min_s=3.0, max_iters=512):
+    """Warm call, then measure average seconds/iter over a timed window
+    (the reference harness's measurement discipline, test/test.py:25-37)."""
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        fn()
+        n += 1
+        dt = time.perf_counter() - t0
+        if (n >= min_iters and dt >= min_s) or n >= max_iters:
+            return dt / n
+
+
+def amortized_forward_seconds(apply_fn, params, x0, k: int, *,
+                              min_iters: int = 3, min_s: float = 2.0,
+                              max_iters: int = 64) -> float:
+    """Per-forward seconds with ``k`` forwards fused in ONE dispatch.
+
+    On a chip behind a high-RTT link (the axon tunnel: ~76 ms/sync,
+    PROFILE_r04.md) per-step dispatch+sync measures the link, not the
+    chip; fusing K forwards into one on-device ``lax.scan`` amortizes the
+    round trip away.  The per-step input perturbation ``x0 + t`` keeps
+    every iteration's forward live — an invariant body would let XLA
+    hoist the network out of the loop entirely and fake the number.
+    """
+    from jax import lax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def scan_fwd(p, x0, ts):
+        def body(c, t):
+            y = apply_fn(p, x0 + t)
+            return c + y.astype(jnp.float32).sum(), None
+
+        s, _ = lax.scan(body, jnp.float32(0), ts)
+        return s
+
+    ts = jnp.linspace(0, 1e-6, k).astype(x0.dtype)
+    sec = timed_window(
+        lambda: jax.block_until_ready(scan_fwd(params, x0, ts)),
+        min_iters=min_iters, min_s=min_s, max_iters=max_iters)
+    return sec / k
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture an XLA/TPU profiler trace (view with tensorboard/xprof)."""

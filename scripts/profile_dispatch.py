@@ -1,0 +1,134 @@
+"""Per-phase breakdown of the flat ~76 ms/step seen in BENCH_r03.json.
+
+Measures, on the real chip, each candidate component of a pipeline step:
+
+  sync_rtt        — trivial jit program, block_until_ready per call
+                    (host<->device round trip incl. the axon tunnel)
+  async_dispatch  — same program, 100 chained calls, one final block
+                    (marginal cost of an *enqueued* execution)
+  h2d / d2h       — host->device and device->host of one ResNet50 input /
+                    output block
+  compute_b{B}    — ResNet50 bf16 forward at batch B, amortized over a
+                    K-step on-device lax.scan (per-step device compute,
+                    no per-step host involvement)
+  stepwise_b{B}   — the same forward dispatched per step with a sync
+                    (the r3 bench protocol — what produced the 76 ms)
+
+Prints one JSON dict; PROFILE_r04.md is written from this.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, iters, warmup=2):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    devices = jax.devices()
+    dev = devices[0]
+    out = {"device_kind": str(getattr(dev, "device_kind", "")),
+           "platform": dev.platform}
+    print(f"profiling on {dev.platform} | {out['device_kind']}",
+          file=sys.stderr, flush=True)
+
+    # --- 1. sync round-trip of a trivial program
+    f = jax.jit(lambda x: x + 1.0)
+    x0 = jnp.zeros(())
+    jax.block_until_ready(f(x0))
+    out["sync_rtt_ms"] = round(
+        timeit(lambda: jax.block_until_ready(f(x0)), 20) * 1e3, 3)
+
+    # --- 2. marginal cost of an async (queued) dispatch
+    def chain(n=100):
+        y = x0
+        for _ in range(n):
+            y = f(y)
+        jax.block_until_ready(y)
+
+    chain(5)
+    t0 = time.perf_counter()
+    chain(100)
+    out["async_dispatch_ms"] = round((time.perf_counter() - t0) / 100 * 1e3,
+                                     4)
+
+    # --- 3. host<->device transfers (one input / 32-batch input)
+    one = np.zeros((1, 224, 224, 3), np.float32)
+    b32 = np.zeros((32, 224, 224, 3), np.float32)
+    out["h2d_1img_ms"] = round(
+        timeit(lambda: jax.block_until_ready(jax.device_put(one)), 10) * 1e3,
+        3)
+    out["h2d_32img_ms"] = round(
+        timeit(lambda: jax.block_until_ready(jax.device_put(b32)), 10) * 1e3,
+        3)
+    dlogits = jnp.zeros((32, 1000), jnp.float32)
+    jax.block_until_ready(dlogits)
+    out["d2h_32logits_ms"] = round(
+        timeit(lambda: np.asarray(dlogits), 10) * 1e3, 3)
+
+    # --- 4. ResNet50 bf16 forward: true device compute via on-device scan
+    from defer_tpu.graph.analysis import total_flops
+    from defer_tpu.models import resnet50
+    from defer_tpu.utils.hw import identify_chip, peak_flops
+
+    g = resnet50()
+    params = jax.tree.map(lambda a: jnp.asarray(a, jnp.bfloat16),
+                          g.init(jax.random.key(0)))
+    flops = float(total_flops(g))
+    peak = peak_flops(identify_chip(dev))
+    out["flops_per_img"] = flops
+    out["peak_flops"] = peak
+
+    from defer_tpu.utils.profiling import amortized_forward_seconds
+
+    fwd = jax.jit(lambda p, x: g.apply(p, x))
+
+    for batch, k in ((1, 64), (8, 64), (32, 32), (64, 32), (128, 16)):
+        try:
+            x0 = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+            sec = amortized_forward_seconds(g.apply, params, x0, k)
+            out[f"compute_b{batch}_ms_per_step"] = round(sec * 1e3, 3)
+            out[f"compute_b{batch}_mfu"] = round(
+                flops * batch / sec / peak, 4) if peak else None
+            print(f"compute b{batch}: {sec * 1e3:.3f} ms/step "
+                  f"MFU {out[f'compute_b{batch}_mfu']}",
+                  file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — OOM at big batches is data
+            out[f"compute_b{batch}_error"] = repr(e)[:200]
+
+    # --- 5. the r3 protocol for contrast: per-step dispatch + sync
+    for batch in (1, 32):
+        xb = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+        jax.block_until_ready(fwd(params, xb))
+        sec = timeit(lambda: jax.block_until_ready(fwd(params, xb)), 8)
+        out[f"stepwise_b{batch}_ms"] = round(sec * 1e3, 3)
+
+    # --- 6. per-step dispatch, async window (W in flight, block at end)
+    for batch, w in ((32, 16),):
+        xb = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+        jax.block_until_ready(fwd(params, xb))
+
+        def window():
+            ys = [fwd(params, xb) for _ in range(w)]
+            jax.block_until_ready(ys[-1])
+
+        sec = timeit(window, 4) / w
+        out[f"async_window_b{batch}_ms_per_step"] = round(sec * 1e3, 3)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
