@@ -168,8 +168,6 @@ def main():
 
     devices = init_devices()
 
-    import collections
-    import math
 
     import jax
     import jax.numpy as jnp
@@ -226,6 +224,7 @@ def main():
             else None
 
     from defer_tpu.utils.profiling import (amortized_forward_seconds,
+                                           pipeline_window_seconds,
                                            timed_window)
 
     def scan_step_seconds(b, k):
@@ -279,37 +278,10 @@ def main():
                             compute_dtype=compute_dtype, wire=wire)
         inputs = pipe.stage_inputs(
             np.zeros((chunk, mb) + in_shape, np.float32))
-        # warm-compile by pushing the resident input block as bubbles
-        # instead of pipe.warmup(): warmup would cache a SECOND chunk-sized
-        # bubble block on device, doubling the footprint the mem_cap guard
-        # accounts for
-        pipe.reset()
-        slab, _ = pipe.push(inputs, n_real=0, raw=True)
-        if slab is not None:
-            np.asarray(slab)
-        pipe.reset()
-
-        def run_window(m_chunks):
-            # no per-chunk sync: keep two chunk dispatches in flight and
-            # drain each completed chunk's result slab to the host (the
-            # reference harness also counts only results that arrived,
-            # test/test.py:29-37)
-            pending = collections.deque()
-            t0 = time.perf_counter()
-            for _ in range(m_chunks):
-                slab, _mask = pipe.push(inputs, raw=True)
-                if slab is not None:
-                    pending.append(slab)
-                while len(pending) > 2:
-                    np.asarray(pending.popleft())
-            while pending:
-                np.asarray(pending.popleft())
-            return time.perf_counter() - t0
-
-        run_window(2)  # post-compile warm pass
-        t1 = max(run_window(1), 1e-4)
-        m = max(2, min(64, math.ceil(2.5 / t1)))
-        sec = run_window(m) / m
+        # >=2 chunks in flight, whole-chunk result drains, bubble-free
+        # warm-compile (warmup() would cache a SECOND chunk-sized block,
+        # doubling the footprint the mem_cap guard accounts for)
+        sec = pipeline_window_seconds(pipe, inputs)
         return pipe, chunk * mb / sec, sec
 
     pipe_sweep = {}
@@ -401,8 +373,7 @@ def main():
             int8_row = {"error": repr(e)[:200]}
 
     # ---- padded-buffer waste: what each hop actually carries vs buf_elems
-    hop_elems = [s.out_spec.size for s in stages]  # hop k = stage k's output
-    buffer_util = [round(h / pipe.buf_elems, 4) for h in hop_elems]
+    buffer_util = [round(u, 4) for u in pipe.hop_utilization]
 
     model = "resnet50" if on_tpu else "resnet_tiny"
     result = {

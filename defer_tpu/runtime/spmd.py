@@ -499,6 +499,15 @@ class SpmdPipeline:
     # diagnostics
     # ------------------------------------------------------------------
 
+    @property
+    def hop_utilization(self) -> list[float]:
+        """Fraction of the homogeneous ``buf_elems`` hop buffer each
+        stage->successor boundary actually carries (hop k = stage k's
+        output; the last entry is the wrap link back to "the dispatcher").
+        The padded-buffer waste diagnostic: every ``ppermute`` hop and
+        every ``xs`` transfer pays ``buf_elems`` regardless."""
+        return [s.out_spec.size / self.buf_elems for s in self.stages]
+
     def stage_latencies(self, params: dict[str, Any] | None = None,
                         iters: int = 10):
         """Per-stage device latency (seconds) of the *deployed* program.

@@ -20,6 +20,14 @@ class PipelineMetrics:
     buffer_elems: int = 0
     buffer_bytes_per_hop: int = 0
 
+    def clear_counters(self):
+        """Zero the streaming counters (keep stage latencies / geometry) —
+        e.g. after a harness's warmup pushes, before a measured window."""
+        self.inferences = 0
+        self.steps = 0
+        self.wall_s = 0.0
+        self.chunk_calls = 0
+
     @property
     def throughput(self) -> float:
         return self.inferences / self.wall_s if self.wall_s > 0 else 0.0
