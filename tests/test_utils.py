@@ -87,3 +87,33 @@ def test_checkpoint_path_without_suffix(tmp_path):
     a = jax.tree_util.tree_leaves(params)[0]
     b = jax.tree_util.tree_leaves(restored)[0]
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_metrics_clear_counters():
+    """clear_counters zeroes the streaming counters but keeps geometry and
+    stage latencies (harness warmup must not pollute a measured window)."""
+    from defer_tpu.utils.metrics import PipelineMetrics
+
+    m = PipelineMetrics(num_stages=4, microbatch=2, buffer_elems=64,
+                        buffer_bytes_per_hop=256)
+    m.inferences, m.steps, m.wall_s, m.chunk_calls = 10, 20, 1.5, 3
+    m.stage_latency_s = [0.1, 0.2]
+    m.clear_counters()
+    assert (m.inferences, m.steps, m.wall_s, m.chunk_calls) == (0, 0, 0.0, 0)
+    assert m.stage_latency_s == [0.1, 0.2]
+    assert m.num_stages == 4 and m.buffer_elems == 64
+
+
+def test_hop_utilization_property():
+    """hop_utilization = per-stage out size / buf_elems, in stage order."""
+    from defer_tpu import SpmdPipeline, partition, pipeline_mesh
+
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    stages = partition(g, num_stages=4)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(4),
+                        microbatch=1, chunk=2)
+    util = pipe.hop_utilization
+    assert len(util) == 4
+    assert util == [s.out_spec.size / pipe.buf_elems for s in stages]
+    assert all(0 < u <= 1 for u in util)
