@@ -282,12 +282,24 @@ class Defer:
                         with conn_lock:
                             send_end(conn)
                         return
-                    outs = pipe.push(
+                    slab, mask = pipe.push(
                         block.reshape(pipe.chunk, mb, buf), n_real=got,
-                        staged=True)
-                    for o in outs:
+                        staged=True, raw=True)
+                    if slab is None:
+                        continue
+                    real = np.flatnonzero(mask)
+                    if real.size == 0:
+                        continue
+                    if real.size < len(mask):
+                        # trickle traffic: gather real rows on device so
+                        # the host transfer never carries bubble padding
+                        slab = slab[real]
+                    # ONE device->host drain per chunk, then frame out
+                    arr = np.asarray(slab, np.float32)
+                    out_shape = (mb,) + pipe.out_spec.shape
+                    for row in arr:
                         with conn_lock:
-                            send_frame(conn, np.asarray(o, np.float32),
+                            send_frame(conn, row.reshape(out_shape),
                                        codec=codec)
             except BaseException as e:  # noqa: BLE001 — surfaced on .errors
                 errors.append(e)

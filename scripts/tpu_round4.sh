@@ -1,0 +1,28 @@
+#!/bin/bash
+# One-shot round-4 TPU measurement session (single-client tunnel: strictly
+# sequential).  Produces, at the repo root:
+#   PROFILE_r04.json      per-phase dispatch/RTT/compute breakdown
+#   BENCH_r04_builder.json  headline bench (driver runs its own BENCH_r04)
+#   BENCHMARKS_r04.json   the five BASELINE configs (one JSON line each)
+# Usage: bash scripts/tpu_round4.sh [repo_root]
+set -u
+cd "${1:-/root/repo}"
+
+echo "[tpu_round4] $(date +%H:%M:%S) profile_dispatch" >&2
+timeout 1800 python scripts/profile_dispatch.py > PROFILE_r04.json \
+    2> /tmp/profile_r04.err
+echo "[tpu_round4] profile rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[tpu_round4] $(date +%H:%M:%S) bench.py (full sweep)" >&2
+DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_ATTEMPTS=2 \
+    timeout 2700 python bench.py > BENCH_r04_builder.json \
+    2> /tmp/bench_r04.err
+echo "[tpu_round4] bench rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[tpu_round4] $(date +%H:%M:%S) benchmarks/run.py (5 configs)" >&2
+timeout 3600 python benchmarks/run.py > BENCHMARKS_r04.json \
+    2> /tmp/benchmarks_r04.err
+echo "[tpu_round4] suite rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[tpu_round4] done; artifact sizes:" >&2
+wc -c PROFILE_r04.json BENCH_r04_builder.json BENCHMARKS_r04.json >&2
