@@ -15,8 +15,9 @@ echo "[tpu_round4] profile rc=$? $(date +%H:%M:%S)" >&2
 
 echo "[tpu_round4] $(date +%H:%M:%S) bench.py (full sweep)" >&2
 DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_ATTEMPTS=2 \
-    timeout 2700 python bench.py > BENCH_r04_builder.json \
-    2> /tmp/bench_r04.err
+    timeout 2700 python bench.py \
+    --chunks 32,128,512 --microbatches 1,8,32 \
+    > BENCH_r04_builder.json 2> /tmp/bench_r04.err
 echo "[tpu_round4] bench rc=$? $(date +%H:%M:%S)" >&2
 
 echo "[tpu_round4] $(date +%H:%M:%S) benchmarks/run.py (5 configs)" >&2
