@@ -158,11 +158,12 @@ def main():
                          "(npz/safetensors; see defer_tpu.utils.pretrained)")
     ap.add_argument("--batches", default="1,8,32,128",
                     help="baseline batch sweep sizes (TPU only)")
-    # default sweep is the 2x2 corners (bounded wall clock for unattended
-    # runs); scripts/tpu_round4.sh passes the full 3x3 matrix
+    # default sweep is 2x2 corners chosen to fit the mem_cap guard on the
+    # single-chip ResNet50 buffer (512*16*150528*2B just fits 2.5 GB), so
+    # all four actually run; scripts/tpu_round4.sh passes the full 3x3
     ap.add_argument("--chunks", default="32,512",
                     help="pipeline chunk sweep (steps fused per dispatch)")
-    ap.add_argument("--microbatches", default="1,32",
+    ap.add_argument("--microbatches", default="1,16",
                     help="pipeline microbatch sweep")
     ap.add_argument("--quick", action="store_true",
                     help="small sweep: batches 1,32; one pipeline config")

@@ -12,6 +12,14 @@ echo "[tpu_round4] $(date +%H:%M:%S) profile_dispatch" >&2
 timeout 1800 python scripts/profile_dispatch.py > PROFILE_r04.json \
     2> /tmp/profile_r04.err
 echo "[tpu_round4] profile rc=$? $(date +%H:%M:%S)" >&2
+if [ -s PROFILE_r04.json ]; then
+    if python scripts/render_profile.py PROFILE_r04.json > PROFILE_r04.md
+    then
+        echo "[tpu_round4] rendered PROFILE_r04.md" >&2
+    else
+        echo "[tpu_round4] render_profile FAILED (md left empty)" >&2
+    fi
+fi
 
 echo "[tpu_round4] $(date +%H:%M:%S) bench.py (full sweep)" >&2
 DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_ATTEMPTS=2 \
