@@ -268,9 +268,9 @@ def main():
                            num_stages=None if on_tpu else 8)
     else:
         stages = partition(graph, num_stages=num_stages)
+    from defer_tpu.partition.stage import buffer_footprint
     buffer_dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    buf_elems = max([s.in_spec.size for s in stages]
-                    + [s.out_spec.size for s in stages])
+    buf_elems = buffer_footprint(stages)["buf_elems"]
     mem_cap = 2.5e9  # device bytes allowed for the resident input block
 
     def bench_pipe(chunk, mb, wire="buffer"):

@@ -58,14 +58,13 @@ def cmd_partition(args):
         print(f"  {s}")
     # padded-buffer waste: every hop of the homogeneous SPMD transfer
     # buffer pays buf_elems regardless of what the boundary carries
-    buf_elems = max([s.in_spec.size for s in stages]
-                    + [s.out_spec.size for s in stages])
-    print(f"  transfer buffer: {buf_elems} elems/hop "
+    from .partition.stage import buffer_footprint
+    fp = buffer_footprint(stages)
+    print(f"  transfer buffer: {fp['buf_elems']} elems/hop "
           f"(max stage boundary; every hop pays this)")
-    for s in stages:
+    for s, util in zip(stages, fp["hop_utilization"]):
         dst = f"stage {s.index + 1}" if s.index + 1 < len(stages) \
             else "dispatcher (wrap)"
-        util = s.out_spec.size / buf_elems
         print(f"    hop {s.index}->{dst}: carries {s.out_spec.size} elems "
               f"({util:.1%} of buffer)")
     if args.summary:

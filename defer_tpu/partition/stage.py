@@ -18,6 +18,32 @@ import jax
 from ..graph.ir import LayerGraph, ShapeSpec
 
 
+def buffer_footprint(stages, *, microbatch: int = 1, itemsize: int = 4,
+                     wire: str = "buffer") -> dict:
+    """Homogeneous transfer-buffer geometry for a stage list.
+
+    The single source of truth for what every SPMD hop carries —
+    ``SpmdPipeline``, the CLI partition table, and the benchmark suite all
+    derive from this so reported waste always matches the deployed buffer:
+    ``buf_elems`` (max stage boundary, padded to the int8 block size under
+    ``wire="int8"``), ``hop_utilization`` (hop k = stage k's output), and
+    ``bytes_per_hop`` (int8: ~1 byte/value + one f32 scale per block).
+    """
+    buf = max([s.in_spec.size for s in stages]
+              + [s.out_spec.size for s in stages])
+    if wire == "int8":
+        from ..ops.quant import BLOCK
+        buf = -(-buf // BLOCK) * BLOCK
+        hop_bytes = microbatch * (buf + 4 * (buf // BLOCK))
+    else:
+        hop_bytes = buf * microbatch * itemsize
+    return {
+        "buf_elems": buf,
+        "hop_utilization": [s.out_spec.size / buf for s in stages],
+        "bytes_per_hop": hop_bytes,
+    }
+
+
 @dataclasses.dataclass(frozen=True)
 class StageSpec:
     index: int

@@ -45,7 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graph.ir import ShapeSpec
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS, pipeline_mesh
-from ..partition.stage import StageSpec
+from ..partition.stage import StageSpec, buffer_footprint
 from ..utils.metrics import PipelineMetrics
 
 
@@ -136,19 +136,18 @@ class SpmdPipeline:
         self._wspec = wspec
         self._w = jax.device_put(wbuf, NamedSharding(self.mesh, wspec))
 
-        # --- homogeneous activation buffer sizing
+        # --- homogeneous activation buffer sizing (shared geometry
+        # helper: under wire="int8" the buffer pads to the quant block
+        # size so hops block-quantize cleanly in HBM)
         if wire not in ("buffer", "int8"):
             raise ValueError(f"wire must be 'buffer' or 'int8', got {wire!r}")
         self.wire = wire
         self._in_sizes = [s.in_spec.size for s in self.stages]
         self._out_sizes = [s.out_spec.size for s in self.stages]
-        self.buf_elems = max(self._in_sizes + self._out_sizes)
-        if wire == "int8":
-            # the stage->stage hop is block-quantized in HBM (the device-
-            # side analogue of the reference's ZFP wire compression);
-            # blocks share one scale, so pad the buffer to a block multiple
-            from ..ops.quant import BLOCK
-            self.buf_elems = -(-self.buf_elems // BLOCK) * BLOCK
+        self._footprint = buffer_footprint(
+            self.stages, microbatch=microbatch,
+            itemsize=self.buffer_dtype.itemsize, wire=wire)
+        self.buf_elems = self._footprint["buf_elems"]
         self.in_spec: ShapeSpec = self.stages[0].in_spec
         self.out_spec: ShapeSpec = self.stages[-1].out_spec
 
@@ -169,17 +168,9 @@ class SpmdPipeline:
                 "buffer_dtype=float32: ids above 256 are not exactly "
                 f"representable in {self.buffer_dtype.name}")
 
-        if wire == "int8":
-            from ..ops.quant import BLOCK
-            # int8 payload + one f32 scale per block
-            hop_bytes = self.microbatch * (
-                self.buf_elems + 4 * (self.buf_elems // BLOCK))
-        else:
-            hop_bytes = (self.buf_elems * self.microbatch
-                         * self.buffer_dtype.itemsize)
         self.metrics = PipelineMetrics(
             num_stages=n, microbatch=microbatch, buffer_elems=self.buf_elems,
-            buffer_bytes_per_hop=hop_bytes)
+            buffer_bytes_per_hop=self._footprint["bytes_per_hop"])
         self._flush_zeros = None  # lazy device-resident bubble block
         self.reset()
 
@@ -506,7 +497,7 @@ class SpmdPipeline:
         output; the last entry is the wrap link back to "the dispatcher").
         The padded-buffer waste diagnostic: every ``ppermute`` hop and
         every ``xs`` transfer pays ``buf_elems`` regardless."""
-        return [s.out_spec.size / self.buf_elems for s in self.stages]
+        return list(self._footprint["hop_utilization"])
 
     def stage_latencies(self, params: dict[str, Any] | None = None,
                         iters: int = 10):
