@@ -40,6 +40,7 @@ from .partition.stage import StageSpec
 from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
 from .runtime.mpmd import MpmdPipeline
 from .runtime.spmd import SpmdPipeline
+from .runtime.training import PipelineTrainer
 from .utils.checkpoint import load_params, save_params
 from .utils.export import export_pipeline, export_stage, load_stage
 from .utils.config import DeferConfig
@@ -53,7 +54,8 @@ __all__ = [
     "partition", "valid_cut_points", "auto_cut_points", "total_flops",
     "summary", "to_dot",
     "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",
-    "SpmdPipeline", "MpmdPipeline", "Defer", "DeferHandle", "DeferConfig",
+    "SpmdPipeline", "MpmdPipeline", "PipelineTrainer", "Defer",
+    "DeferHandle", "DeferConfig",
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
     "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",
     "sequence_parallel_attention_ulysses", "ulysses_attention",
