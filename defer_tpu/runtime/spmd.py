@@ -101,9 +101,17 @@ class SpmdPipeline:
             else np.float32)
         self._wmeta: list[list[tuple[int, int, tuple[int, ...], Any]]] = []
         self._wtreedef = []
+        #: per stage, per leaf: True when the leaf is REPLICATED across tp
+        #: ranks (its shard shape equals the full leaf's shape) — the
+        #: trainer needs this to sum tied-copy gradients across ranks
+        self._wreplicated: list[list[bool]] = []
         flats: list[list[np.ndarray]] = []  # [stage][tp_rank]
         for s in self.stages:
             rank_flats = []
+            full_shapes = None
+            if tp > 1:
+                full_shapes = [np.shape(l) for l in
+                               jax.tree.flatten(s.select_params(params))[0]]
             for r in range(tp):
                 shard = (s.tp_shard_params(params, tp, r) if tp > 1
                          else s.select_params(params))
@@ -116,6 +124,11 @@ class SpmdPipeline:
                         off += leaf.size
                     self._wmeta.append(meta)
                     self._wtreedef.append(treedef)
+                    self._wreplicated.append(
+                        [np.shape(l) == fs for l, fs
+                         in zip(leaves, full_shapes)]
+                        if full_shapes is not None
+                        else [True] * len(leaves))
                 rank_flats.append(
                     np.concatenate([self._to_wire(np.asarray(l), s.name)
                                     .ravel() for l in leaves])

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, STAGE_AXIS
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from .spmd import SpmdPipeline
 
 
@@ -48,16 +48,13 @@ class PipelineTrainer:
     gradient transformation; it runs directly on the stage-sharded flat
     weight buffer in one jitted fused update.
 
-    Restrictions (v1): pipeline (+ data-parallel) meshes only — tensor/
-    expert-parallel stages raise.
+    Supports pp, pp x dp, and pp x tp meshes (the Megatron in-stage psums
+    transpose correctly under autodiff).  ``wire="int8"`` pipelines raise:
+    training differentiates the raw buffer wire.
     """
 
     def __init__(self, pipe: SpmdPipeline, loss_fn: Callable,
                  optimizer=None):
-        if pipe.tensor_parallel > 1:
-            raise NotImplementedError(
-                "PipelineTrainer v1 supports pp(+dp) meshes; "
-                "tensor-parallel stages are inference-only for now")
         if pipe.wire != "buffer":
             raise NotImplementedError(
                 "training differentiates the raw buffer wire; "
@@ -72,6 +69,27 @@ class PipelineTrainer:
         #: (the target sharding spec must match ys's rank)
         self._loss_grad_cache: dict[int, Any] = {}
         self.opt_state = None  # lazily init'd on device from pipe._w
+        self._fix_tp_grads = None
+        if pipe.tensor_parallel > 1:
+            # tied-copy gradient correction: a REPLICATED leaf exists once
+            # per tp rank in the weight buffer, and value_and_grad hands
+            # each copy only its own rank's partial (scaled 1/tp by the
+            # loss pmean) — the correct tied-weight gradient is the SUM of
+            # the copies' grads.  Sharded leaves are rank-owned: untouched.
+            n, pmax = pipe._w.shape[0], pipe._w.shape[-1]
+            rep = np.zeros((n, 1, pmax), bool)
+            for k, (meta, flags) in enumerate(zip(pipe._wmeta,
+                                                  pipe._wreplicated)):
+                for (off, size, _shape, _dt), is_rep in zip(meta, flags):
+                    if is_rep:
+                        rep[k, 0, off: off + size] = True
+            rep = jnp.asarray(rep)
+
+            @jax.jit
+            def fix(g):
+                return jnp.where(rep, g.sum(axis=1, keepdims=True), g)
+
+            self._fix_tp_grads = fix
         self._a0 = None        # cached sharded all-zeros activation block
         # one fused program per optimizer step instead of eager per-op
         # dispatches over the full weight buffer
@@ -102,10 +120,16 @@ class PipelineTrainer:
         mb_local = pipe.microbatch // pipe.data_parallel
         loss_fn = self.loss_fn
 
+        has_tp = pipe.tensor_parallel > 1
+
         def device_chunk(w, a0, xs, ys, mask):
-            # local: w [1, Pmax], a0 [1, B, L], xs [T, B, L],
-            # ys [T, B, *target], mask [T]
-            w_l = w[0]
+            # local: w [1, (1,) Pmax], a0 [1, B, L], xs [T, B, L],
+            # ys [T, B, *target], mask [T].  Under tp each model rank runs
+            # its own stage ring on its weight shard; in-stage psums make
+            # activations (and hence the loss) replicated across ranks,
+            # and their transposes route each rank's shard gradient — so
+            # the same differentiation covers pp x tp x dp.
+            w_l = w[0, 0] if has_tp else w[0]
             idx = lax.axis_index(STAGE_AXIS)
 
             @jax.checkpoint
@@ -125,7 +149,12 @@ class PipelineTrainer:
                     loss_fn(out.reshape((mb_local,) + out_shape), y), 0.0)
                 return y_next, step_loss
 
-            _a_t, losses = lax.scan(body, a0[0], (xs, ys, mask))
+            a_init = a0[0]
+            if has_tp:
+                # the tp-rank rings produce replicated values the VMA
+                # system types as model-varying; match the carry type
+                a_init = lax.pcast(a_init, (MODEL_AXIS,), to="varying")
+            _a_t, losses = lax.scan(body, a_init, (xs, ys, mask))
             total = jnp.where(idx == 0, losses.sum(), 0.0)
             # replicate the scalar so every shard returns the same loss;
             # pmean over dp so a mean-over-batch loss_fn keeps per-sample
@@ -134,6 +163,10 @@ class PipelineTrainer:
             total = lax.psum(total, STAGE_AXIS)
             if has_dp:
                 total = lax.pmean(total, DATA_AXIS)
+            if has_tp:
+                # numerically identity (ranks hold the same loss); types
+                # the scalar back to model-invariant for out_specs P()
+                total = lax.pmean(total, MODEL_AXIS)
             return total
 
         bspec = P(STAGE_AXIS, DATA_AXIS, None) if has_dp \
@@ -143,11 +176,15 @@ class PipelineTrainer:
         # under dp, replicate everything else, matched to ys's rank
         yspec = P(None, DATA_AXIS if has_dp else None,
                   *([None] * (ys_ndim - 2)))
+        # NOTE check_vma=True (unlike the inference engine): replication
+        # tracking is what makes the TRANSPOSE of the in-stage Megatron
+        # psums correct — with it off, a replicated cotangent re-enters
+        # psum and every tp-rank gradient double-counts
         fn = jax.shard_map(
             device_chunk, mesh=pipe.mesh,
             in_specs=(pipe._wspec, bspec, xspec, yspec, P(None)),
             out_specs=P(),
-            check_vma=False,
+            check_vma=True,
         )
         return jax.jit(jax.value_and_grad(fn))
 
@@ -183,8 +220,11 @@ class PipelineTrainer:
                 jnp.zeros((pipe.num_stages, pipe.microbatch,
                            pipe.buf_elems), pipe.buffer_dtype),
                 pipe._act_sharding)
-        return self._loss_grad(ys_dev.ndim)(pipe._w, self._a0, xs_dev,
-                                            ys_dev, mask)
+        loss, grads = self._loss_grad(ys_dev.ndim)(pipe._w, self._a0,
+                                                   xs_dev, ys_dev, mask)
+        if self._fix_tp_grads is not None:
+            grads = self._fix_tp_grads(grads)
+        return loss, grads
 
     def step(self, xs: np.ndarray, ys: np.ndarray) -> float:
         """One optimizer step over a chunk; returns the summed loss."""
@@ -199,8 +239,14 @@ class PipelineTrainer:
 
     def stage_grads(self, grads) -> list[dict[str, Any]]:
         """Unflatten a weight-buffer gradient back into per-stage pytrees
-        (host side; for inspection/tests/checkpointing)."""
+        (host side; for inspection/tests/checkpointing).  Under tp the
+        buffer holds per-rank shards whose reassembly is op-specific;
+        inspect the raw [N, tp, Pmax] gradient directly instead."""
         pipe = self.pipe
+        if pipe.tensor_parallel > 1:
+            raise NotImplementedError(
+                "stage_grads reassembly under tensor parallelism; "
+                "read the sharded gradient buffer directly")
         out = []
         g = np.asarray(grads)
         for k, meta in enumerate(pipe._wmeta):
