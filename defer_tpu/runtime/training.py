@@ -237,6 +237,59 @@ class PipelineTrainer:
 
     # -- interop ------------------------------------------------------------
 
+    def trained_params(self) -> dict[str, Any]:
+        """The deployment's CURRENT weights as a standard graph parameter
+        pytree (the inverse of the buffer staging) — restore-anywhere
+        interop with ``utils.checkpoint`` / fresh deployments.  Leaves
+        come back in their original dtypes.  tp>1 raises (shard
+        reassembly is op-specific)."""
+        pipe = self.pipe
+        if pipe.tensor_parallel > 1:
+            raise NotImplementedError(
+                "trained_params reassembly under tensor parallelism")
+        w = np.asarray(pipe._w)
+        params: dict[str, Any] = {}
+        for k, s in enumerate(pipe.stages):
+            leaves = [w[k, off: off + size].reshape(shape).astype(dtype)
+                      for off, size, shape, dtype in pipe._wmeta[k]]
+            params.update(jax.tree.unflatten(pipe._wtreedef[k], leaves))
+        return params
+
+    def save_checkpoint(self, path: str):
+        """Persist the training state (weight buffer + optimizer state)."""
+        from ..utils.checkpoint import save_params
+        if self.opt_state is None:
+            # pre-first-step save must still restore: write the same
+            # opt/s* keys load_checkpoint's template will demand
+            self.opt_state = self.optimizer.init(self.pipe._w)
+        flat, _ = jax.tree.flatten(self.opt_state)
+        save_params(path, {
+            "w": {"buffer": np.asarray(self.pipe._w)},
+            "opt": {f"s{i}": np.asarray(l) for i, l in enumerate(flat)},
+        })
+
+    def load_checkpoint(self, path: str):
+        """Restore training state saved by :meth:`save_checkpoint` into
+        this deployment (same partition/mesh/optimizer)."""
+        from ..utils.checkpoint import load_params
+        pipe = self.pipe
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init(pipe._w)
+        flat, treedef = jax.tree.flatten(self.opt_state)
+        tpl = {"w": {"buffer": np.zeros(pipe._w.shape, pipe._w.dtype)},
+               "opt": {f"s{i}": np.zeros(np.shape(l), np.asarray(l).dtype)
+                       for i, l in enumerate(flat)}}
+        state = load_params(path, tpl)
+        sharding = NamedSharding(pipe.mesh, pipe._wspec)
+        pipe._w = jax.device_put(state["w"]["buffer"], sharding)
+        restored = []
+        for i, l in enumerate(flat):
+            arr = state["opt"][f"s{i}"]
+            restored.append(
+                jax.device_put(arr, sharding) if np.shape(arr) == pipe._w.shape
+                else jnp.asarray(arr))
+        self.opt_state = jax.tree.unflatten(treedef, restored)
+
     def stage_grads(self, grads) -> list[dict[str, Any]]:
         """Unflatten a weight-buffer gradient back into per-stage pytrees
         (host side; for inspection/tests/checkpointing).  Under tp the
