@@ -176,6 +176,57 @@ def cmd_chain(args):
     }))
 
 
+def cmd_train(args):
+    """Pipeline-parallel training demo: synthetic data, cross-entropy,
+    prints per-step loss (JSON line at the end)."""
+    import optax
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import SpmdPipeline, partition, pipeline_mesh
+    from .runtime.training import PipelineTrainer
+
+    graph = _get_model(args.model)
+    params = graph.init(jax.random.key(0))
+    cuts = args.cuts.split(",") if args.cuts else None
+    stages = partition(graph, cuts, num_stages=args.stages)
+    pipe = SpmdPipeline(stages, params, mesh=pipeline_mesh(len(stages)),
+                        microbatch=args.microbatch, chunk=args.chunk,
+                        wire=args.wire)
+    in_spec, out_spec = pipe.in_spec, pipe.out_spec
+    classes = out_spec.shape[-1]
+
+    def ce(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+
+    trainer = PipelineTrainer(pipe, ce, optimizer=optax.adam(args.lr))
+    rng = np.random.default_rng(0)
+    m = args.chunk - len(stages) + 1
+    m = max(m, 1)
+    if jnp.issubdtype(in_spec.dtype, jnp.integer):
+        xs = rng.integers(0, 64, (m, args.microbatch) + in_spec.shape
+                          ).astype(np.float32)
+    else:
+        xs = rng.standard_normal(
+            (m, args.microbatch) + in_spec.shape).astype(np.float32)
+    ys = rng.integers(0, classes, (m, args.microbatch))
+
+    losses = []
+    for i in range(args.steps):
+        t0 = time.perf_counter()
+        loss = trainer.step(xs, ys)
+        losses.append(round(loss, 4))
+        print(f"step {i}: loss {loss:.4f} "
+              f"({time.perf_counter() - t0:.2f}s)", file=sys.stderr)
+    if args.save:
+        trainer.save_checkpoint(args.save)
+        print(f"checkpoint -> {args.save}", file=sys.stderr)
+    print(json.dumps({"model": args.model, "stages": len(stages),
+                      "steps": args.steps, "losses": losses}))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m defer_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -225,10 +276,23 @@ def main(argv=None):
     c.add_argument("--codec", default="raw",
                    choices=["raw", "lzb", "bf8", "bf12", "bf16"])
 
+    t = sub.add_parser("train", help="pipeline-parallel training demo "
+                                     "(synthetic data, cross-entropy)")
+    t.add_argument("--model", default="resnet_tiny")
+    t.add_argument("--stages", type=int, default=4)
+    t.add_argument("--cuts")
+    t.add_argument("--chunk", type=int, default=8)
+    t.add_argument("--microbatch", type=int, default=1)
+    t.add_argument("--steps", type=int, default=5)
+    t.add_argument("--lr", type=float, default=1e-3)
+    t.add_argument("--wire", default="buffer", choices=["buffer", "int8"],
+                   help="int8: train the quantized deployment (STE)")
+    t.add_argument("--save", help="write a training checkpoint here")
+
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition,
      "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
-     "chain": cmd_chain}[args.cmd](args)
+     "chain": cmd_chain, "train": cmd_train}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,20 @@ def quantize_int8_blocks(x: jnp.ndarray, use_pallas: bool | None = None):
     return q.reshape(*lead, n), scale
 
 
+def quantized_ring_hop(y: jnp.ndarray, axis: str, perm, out_dtype):
+    """The int8 stage->successor hop: block-quantize in HBM, ppermute the
+    int8 payload + scales over ICI, dequantize on arrival.
+
+    The single definition shared by the inference engine and the trainer's
+    straight-through forward — training's forward must stay byte-identical
+    to the wire it deploys."""
+    from jax import lax
+    q, s = quantize_int8_blocks(y)
+    q = lax.ppermute(q, axis, perm)
+    s = lax.ppermute(s, axis, perm)
+    return dequantize_int8_blocks(q, s, out_dtype)
+
+
 def dequantize_int8_blocks(q: jnp.ndarray, scale: jnp.ndarray,
                            dtype=jnp.float32):
     """Inverse of :func:`quantize_int8_blocks`."""

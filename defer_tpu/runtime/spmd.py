@@ -263,8 +263,7 @@ class SpmdPipeline:
 
         int8_wire = self.wire == "int8"
         if int8_wire:
-            from ..ops.quant import (dequantize_int8_blocks,
-                                     quantize_int8_blocks)
+            from ..ops.quant import quantized_ring_hop
         buffer_dtype = self.buffer_dtype
         out_sz_last = self._out_sizes[-1]
 
@@ -283,10 +282,8 @@ class SpmdPipeline:
                 if int8_wire:
                     # quantize the hop in HBM: ICI carries ~1 byte/value
                     # (the ZFP-wire analogue, SURVEY.md §2.2)
-                    q, s = quantize_int8_blocks(y)
-                    q = lax.ppermute(q, STAGE_AXIS, perm)
-                    s = lax.ppermute(s, STAGE_AXIS, perm)
-                    y_next = dequantize_int8_blocks(q, s, buffer_dtype)
+                    y_next = quantized_ring_hop(y, STAGE_AXIS, perm,
+                                                buffer_dtype)
                 else:
                     y_next = lax.ppermute(y, STAGE_AXIS, perm)
                 # per-step output: only the slice the dispatcher reads —

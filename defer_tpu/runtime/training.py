@@ -49,16 +49,14 @@ class PipelineTrainer:
     weight buffer in one jitted fused update.
 
     Supports pp, pp x dp, and pp x tp meshes (the Megatron in-stage psums
-    transpose correctly under autodiff).  ``wire="int8"`` pipelines raise:
-    training differentiates the raw buffer wire.
+    transpose correctly under autodiff).  ``wire="int8"`` pipelines train
+    with a straight-through estimator on the quantized hop: the forward
+    is exactly the deployment's quantized wire, the backward treats
+    dequant∘quant as identity (cotangents still ride the reverse ring).
     """
 
     def __init__(self, pipe: SpmdPipeline, loss_fn: Callable,
                  optimizer=None):
-        if pipe.wire != "buffer":
-            raise NotImplementedError(
-                "training differentiates the raw buffer wire; "
-                "wire='int8' (straight-through) not implemented")
         self.pipe = pipe
         self.loss_fn = loss_fn
         if optimizer is None:
@@ -122,6 +120,31 @@ class PipelineTrainer:
 
         has_tp = pipe.tensor_parallel > 1
 
+        if pipe.wire == "int8":
+            # quantized hop with a straight-through estimator: forward
+            # block-quantizes exactly like inference (the deployment being
+            # trained IS the deployment that serves), backward treats
+            # dequant∘quant as identity while still transposing the ring
+            from ..ops.quant import quantized_ring_hop
+            inv_perm = [(k, (k - 1) % n) for k in range(n)]
+            buffer_dtype = pipe.buffer_dtype
+
+            @jax.custom_vjp
+            def hop(y):
+                return quantized_ring_hop(y, STAGE_AXIS, perm,
+                                          buffer_dtype)
+
+            def _hop_fwd(y):
+                return hop(y), None
+
+            def _hop_bwd(_, g):
+                return (lax.ppermute(g, STAGE_AXIS, inv_perm),)
+
+            hop.defvjp(_hop_fwd, _hop_bwd)
+        else:
+            def hop(y):
+                return lax.ppermute(y, STAGE_AXIS, perm)
+
         def device_chunk(w, a0, xs, ys, mask):
             # local: w [1, (1,) Pmax], a0 [1, B, L], xs [T, B, L],
             # ys [T, B, *target], mask [T].  Under tp each model rank runs
@@ -137,7 +160,7 @@ class PipelineTrainer:
                 x, y, m = xym
                 a = jnp.where(idx == 0, x, a)
                 yhat = lax.switch(idx, branches, w_l, a)
-                y_next = lax.ppermute(yhat, STAGE_AXIS, perm)
+                y_next = hop(yhat)
                 # what arrived back at "the dispatcher" this step: a
                 # completed microbatch (only device 0's copy is real).
                 # Bubble steps are masked with where, not multiply: a
