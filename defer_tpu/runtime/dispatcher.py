@@ -122,6 +122,7 @@ class Defer:
             buffer_dtype=jnp.dtype(cfg.buffer_dtype),
             compute_dtype=cfg.compute_dtype,
             wire=cfg.wire,
+            master_weights=cfg.master_weights,
         )
 
     # -- health ------------------------------------------------------------

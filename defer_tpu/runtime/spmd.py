@@ -72,6 +72,7 @@ class SpmdPipeline:
         buffer_dtype=jnp.float32,
         compute_dtype=None,
         wire: str = "buffer",
+        master_weights: bool = False,
     ):
         self.stages = list(stages)
         self.num_stages = n = len(self.stages)
@@ -96,8 +97,14 @@ class SpmdPipeline:
         # buffer is stored in ``compute_dtype`` when set (bf16 deployments
         # hold bf16 weights in HBM — half the footprint, no per-step
         # recast inside the branch); float32 otherwise.
+        # ``master_weights=True`` keeps the buffer f32 regardless and casts
+        # to compute_dtype inside each stage branch — the mixed-precision
+        # training recipe (optimizer updates land in full precision; XLA
+        # fuses the per-step downcast into the stage program).
+        self.master_weights = bool(master_weights)
         self.weight_dtype = wdt = np.dtype(
-            self.compute_dtype if self.compute_dtype is not None
+            self.compute_dtype
+            if self.compute_dtype is not None and not self.master_weights
             else np.float32)
         self._wmeta: list[list[tuple[int, int, tuple[int, ...], Any]]] = []
         self._wtreedef = []
@@ -227,14 +234,13 @@ class SpmdPipeline:
 
         tp = self.tensor_parallel
 
-        wdt = self.weight_dtype
-
         def leaf_dtype(dtype):
-            # under compute_dtype, float leaves stay in the buffer's storage
-            # dtype (the stage computes in it anyway — no per-step recast);
-            # otherwise every leaf restores its exact original dtype
+            # under compute_dtype, float leaves cast to the compute dtype
+            # (a no-op when the buffer already stores it; the per-step
+            # downcast under master_weights — fused by XLA); otherwise
+            # every leaf restores its exact original dtype
             if cd is not None and jnp.issubdtype(dtype, jnp.floating):
-                return wdt
+                return cd
             return dtype
 
         def branch(w_local, a_local):

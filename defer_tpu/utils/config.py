@@ -25,6 +25,11 @@ class DeferConfig:
     buffer_dtype: str = "float32"
     # dtype activations are cast to inside each stage (None = model dtype)
     compute_dtype: str | None = None
+    # keep the flat weight buffer in f32 and cast to compute_dtype inside
+    # each stage branch — the mixed-precision TRAINING recipe (optimizer
+    # updates in full precision); inference-only deployments leave this
+    # off for half the HBM footprint
+    master_weights: bool = False
     # stage->stage hop encoding: "buffer" sends the raw transfer buffer;
     # "int8" block-quantizes the hop in HBM (ICI moves ~1 byte/value — the
     # device-side analogue of the reference's ZFP wire compression)

@@ -3,12 +3,7 @@ dryrun_multichip exercises every sharding (pp/dp ring + raw/int8 drains,
 training step, sp ring attention, tp Megatron, ep MoE) on the virtual
 mesh — the exact validation the driver runs between rounds."""
 
-import os
-import sys
-
 import jax
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def test_entry_eval_shape():
