@@ -14,8 +14,11 @@ entirely ``model.predict`` — reference src/node.py:106).  Conventions:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Sequence
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -252,6 +255,30 @@ class MaxPool(Op):
             (1, self.window, self.window, 1), (1, s, s, 1), self.padding)
 
 
+@functools.lru_cache(maxsize=256)
+def _window_counts(hw: tuple[int, int], window: int, stride: int,
+                   padding: str) -> np.ndarray:
+    """[1, H', W', 1] valid-element count per pooling window (XLA SAME/
+    VALID semantics), as a host-side constant."""
+    h, w = hw
+    padding = padding.upper()  # lax accepts lowercase padding strings
+    if padding == "VALID":
+        oh = (h - window) // stride + 1
+        ow = (w - window) // stride + 1
+        return np.full((1, oh, ow, 1), float(window * window), np.float32)
+    oh, ow = -(-h // stride), -(-w // stride)
+    ph = max((oh - 1) * stride + window - h, 0)
+    pw = max((ow - 1) * stride + window - w, 0)
+    mask = np.zeros((h + ph, w + pw), np.float32)
+    mask[ph // 2: ph // 2 + h, pw // 2: pw // 2 + w] = 1.0
+    out = np.empty((oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            out[i, j] = mask[i * stride: i * stride + window,
+                             j * stride: j * stride + window].sum()
+    return out.reshape(1, oh, ow, 1)
+
+
 @dataclasses.dataclass(frozen=True, repr=False)
 class AvgPool(Op):
     window: int = 2
@@ -261,15 +288,17 @@ class AvgPool(Op):
     def apply(self, params, x):
         del params
         s = self.stride or self.window
-        one = jnp.asarray(1.0, x.dtype)
-        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+        # NOTE the init value must be a python scalar LITERAL: an array
+        # init routes to the generic reduce_window primitive, whose remat
+        # linearization fails under jax.grad(jax.checkpoint(...)) — the
+        # literal routes to the dedicated (transposable) sum primitive
+        summed = lax.reduce_window(x, 0.0, lax.add,
                                    (1, self.window, self.window, 1),
                                    (1, s, s, 1), self.padding)
-        counts = lax.reduce_window(jnp.broadcast_to(one, x.shape),
-                                   jnp.asarray(0, x.dtype), lax.add,
-                                   (1, self.window, self.window, 1),
-                                   (1, s, s, 1), self.padding)
-        return summed / counts
+        # window counts depend only on static shape/padding: bake them in
+        # as a numpy constant
+        counts = _window_counts(x.shape[1:3], self.window, s, self.padding)
+        return summed / jnp.asarray(counts, x.dtype)
 
 
 @dataclasses.dataclass(frozen=True, repr=False)
