@@ -23,19 +23,22 @@ from jax.experimental import pallas as pl
 
 from .quant import BLOCK
 
-#: row-tile width per grid step (multiple of BLOCK; 8 blocks = 2 KiB int8)
-_TILE = 8 * BLOCK
+#: quant blocks handled per grid step.  The kernel views the input as
+#: [n_blocks, BLOCK] — one 256-value quant block per row — so the Pallas
+#: block shape is (_ROWS, BLOCK): both dims satisfy the TPU tiling rule
+#: (rows divisible by 8, lanes divisible by 128), and the scale output's
+#: (_ROWS, 1) block is legal because 1 IS its array's full last dim.
+#: 128 rows x 256 lanes = 128 KiB f32 in VMEM per step.
+_ROWS = 128
 
 
 def _quant_kernel(x_ref, q_ref, s_ref):
-    x = x_ref[...].astype(jnp.float32)          # [1, tile]
-    xb = x.reshape(-1, BLOCK)                   # [tile/BLOCK, BLOCK]
-    xb = jnp.where(jnp.isfinite(xb), xb, 0.0)
-    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    x = x_ref[...].astype(jnp.float32)          # [_ROWS, BLOCK]
+    x = jnp.where(jnp.isfinite(x), x, 0.0)
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
-    q_ref[...] = q.reshape(x_ref.shape)
-    s_ref[...] = scale.reshape(s_ref.shape)
+    q_ref[...] = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    s_ref[...] = scale
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -53,21 +56,24 @@ def quantize_int8_blocks_pallas(x: jnp.ndarray,
     rows = 1
     for d in lead:
         rows *= d
-    xf = x.reshape(rows, n)
+    nblocks = rows * (n // BLOCK)
+    xf = x.reshape(nblocks, BLOCK)
 
-    tile = _TILE if n % _TILE == 0 else BLOCK
-    grid = (rows, n // tile)
+    # ragged edge is safe: each row is one independent quant block, so the
+    # garbage Pallas pads the final partial tile with never reaches a real
+    # row's scale or payload
+    grid = (pl.cdiv(nblocks, _ROWS),)
     q, s = pl.pallas_call(
         _quant_kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((1, tile), lambda r, c: (r, c))],
+        in_specs=[pl.BlockSpec((_ROWS, BLOCK), lambda r: (r, 0))],
         out_specs=[
-            pl.BlockSpec((1, tile), lambda r, c: (r, c)),
-            pl.BlockSpec((1, tile // BLOCK), lambda r, c: (r, c)),
+            pl.BlockSpec((_ROWS, BLOCK), lambda r: (r, 0)),
+            pl.BlockSpec((_ROWS, 1), lambda r: (r, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, n), jnp.int8),
-            jax.ShapeDtypeStruct((rows, n // BLOCK), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, BLOCK), jnp.int8),
+            jax.ShapeDtypeStruct((nblocks, 1), jnp.float32),
         ],
         interpret=interpret,
     )(xf)

@@ -18,8 +18,11 @@ Prints one JSON dict; PROFILE_r04.md is written from this.
 """
 
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -101,8 +104,8 @@ def main():
 
     for batch, k in ((1, 64), (8, 64), (32, 32), (64, 32), (128, 16)):
         try:
-            x0 = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
-            sec = amortized_forward_seconds(g.apply, params, x0, k)
+            xk = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+            sec = amortized_forward_seconds(g.apply, params, xk, k)
             out[f"compute_b{batch}_ms_per_step"] = round(sec * 1e3, 3)
             out[f"compute_b{batch}_mfu"] = round(
                 flops * batch / sec / peak, 4) if peak else None
@@ -118,6 +121,17 @@ def main():
         jax.block_until_ready(fwd(params, xb))
         sec = timeit(lambda: jax.block_until_ready(fwd(params, xb)), 8)
         out[f"stepwise_b{batch}_ms"] = round(sec * 1e3, 3)
+
+    # --- 5b. the tunnel's two latency modes: re-measure the same trivial
+    # scalar sync from step 1 now that a large program has run.  Observed:
+    # ~0.04 ms in a pristine session, ~62-65 ms after the first big
+    # executable — EVERY subsequent sync (block_until_ready or d2h, any
+    # payload size) pays it, and spinning on is_ready() doesn't dodge it.
+    # This, not per-step compute or h2d, is the flat ~70-80 ms of the r3
+    # bench.
+    scalar0 = jnp.zeros(())
+    out["sync_rtt_after_heavy_ms"] = round(
+        timeit(lambda: jax.block_until_ready(f(scalar0)), 20) * 1e3, 3)
 
     # --- 6. per-step dispatch, async window (W in flight, block at end)
     for batch, w in ((32, 16),):
