@@ -1,0 +1,160 @@
+"""GPT-style causal decoder family: full-sequence graph + KV-cache decode.
+
+The reference framework is CNN-only inference (SURVEY.md §2.3); this family
+goes beyond parity: an autoregressive decoder whose full-sequence
+(prefill/scoring) forward rides the ordinary ``SpmdPipeline`` — one
+``block_k`` node per pipeline stage, exactly like BERT-Base/12 — and whose
+token-by-token generation path is served by the pipelined KV-cache engine in
+:mod:`defer_tpu.runtime.decode`.
+
+Each :class:`CausalTransformerBlock` is one graph node (a natural
+single-tensor cut point) and additionally exposes :meth:`decode` — the
+single-token step against a key/value cache that the decode engine switches
+on per stage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graph.ir import GraphBuilder, LayerGraph, Op
+from ..graph.ops import Dense, LayerNorm, TransformerBlock, _cast
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class CausalTransformerBlock(TransformerBlock):
+    """Pre-LN decoder block: causal self-attention + MLP.
+
+    Full-sequence ``apply`` masks causally (flash kernel's bottom-right
+    alignment, ops/flash_attention.py); ``decode`` is the incremental
+    single-token step used by the pipelined decoder.
+    """
+
+    def _attend(self, q, k, v):
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        if impl not in ("flash", "xla"):
+            raise ValueError(
+                f"attn_impl must be 'auto', 'flash' or 'xla', got {impl!r}")
+        if impl == "flash":
+            from ..ops import flash_attention
+            return flash_attention(q, k, v, causal=True)
+        hd = q.shape[-1]
+        t_q, t_k = q.shape[2], k.shape[2]
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+        q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
+        mask = q_pos >= jnp.arange(t_k)[None, :]
+        att = jnp.where(mask, att, jnp.asarray(-jnp.inf, att.dtype))
+        att = jax.nn.softmax(att, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", att, v)
+
+    def decode(self, params, x, k_cache, v_cache, pos):
+        """One-token step: ``x`` [b, d] at position ``pos``.
+
+        ``k_cache``/``v_cache`` are [b, L, d] with L > max position; the new
+        key/value row is written at ``pos`` (callers pass a clamped scratch
+        index for bubble steps) and attention covers positions <= ``pos``.
+        Returns ``(y [b, d], k_cache, v_cache)``.
+        """
+        p = _cast(params, x.dtype)
+        b, d = x.shape
+        nh = self.num_heads
+        hd = d // nh
+        cache_len = k_cache.shape[1]
+
+        y = self._ln(p["ln1"], x)
+        qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
+        q, k_new, v_new = jnp.split(qkv, 3, axis=-1)       # [b, d] each
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k_new[:, None, :].astype(k_cache.dtype), (0, pos, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v_new[:, None, :].astype(v_cache.dtype), (0, pos, 0))
+
+        qh = q.reshape(b, nh, hd)
+        kh = k_cache.astype(x.dtype).reshape(b, cache_len, nh, hd)
+        vh = v_cache.astype(x.dtype).reshape(b, cache_len, nh, hd)
+        att = jnp.einsum("bhd,blhd->bhl", qh, kh) / math.sqrt(hd)
+        live = jnp.arange(cache_len)[None, None, :] <= pos
+        att = jnp.where(live, att, jnp.asarray(-jnp.inf, att.dtype))
+        att = jax.nn.softmax(att, axis=-1)
+        y = jnp.einsum("bhl,blhd->bhd", att, vh).reshape(b, d)
+        x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
+
+        y = self._ln(p["ln2"], x)
+        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
+        return x + (y @ p["fc2"]["w"] + p["fc2"]["b"]), k_cache, v_cache
+
+
+class GptEmbedding(Op):
+    """Token + learned positional embeddings (GPT-2 style, no post-LN)."""
+
+    def __init__(self, vocab: int, features: int, max_len: int):
+        self.vocab = vocab
+        self.features = features
+        self.max_len = max_len
+
+    def init(self, key, in_specs):
+        del in_specs
+        k1, k2 = jax.random.split(key)
+        return {
+            "wte": jax.random.normal(k1, (self.vocab, self.features),
+                                     jnp.float32) * 0.02,
+            "wpe": jax.random.normal(k2, (self.max_len, self.features),
+                                     jnp.float32) * 0.01,
+        }
+
+    def apply(self, params, ids):
+        t = ids.shape[1]
+        return (params["wte"][ids.astype(jnp.int32)]
+                + params["wpe"][:t])
+
+    def embed_at(self, params, ids, pos):
+        """Decode-path embedding: ``ids`` [b] at scalar position ``pos``."""
+        tok = params["wte"][ids.astype(jnp.int32)]
+        return tok + lax.dynamic_slice(params["wpe"], (pos, 0),
+                                       (1, self.features))[0]
+
+    def flops(self, in_specs, out_spec):
+        return out_spec.size
+
+
+def gpt(num_layers: int, hidden: int, heads: int, seq_len: int,
+        vocab: int = 50257, name: str = "gpt") -> LayerGraph:
+    """Causal LM graph: ids [t] -> logits [t, vocab].
+
+    ``block_k`` nodes are the pipeline cut points; the decode engine
+    (:mod:`defer_tpu.runtime.decode`) consumes the same graph by node-name
+    contract: ``embeddings``, ``block_0..``, ``final_ln``, ``lm_head``.
+    """
+    b = GraphBuilder(name)
+    x = b.input((seq_len,), jnp.int32)
+    x = b.add(GptEmbedding(vocab, hidden, seq_len), x, name="embeddings")
+    for i in range(num_layers):
+        x = b.add(CausalTransformerBlock(heads), x, name=f"block_{i}")
+    x = b.add(LayerNorm(), x, name="final_ln")
+    x = b.add(Dense(vocab), x, name="lm_head")
+    return b.build()
+
+
+def gpt_small(seq_len: int = 256) -> LayerGraph:
+    """GPT-2 small geometry (12 layers, d=768, 12 heads)."""
+    return gpt(12, 768, 12, seq_len, name="gpt_small")
+
+
+def gpt_tiny(seq_len: int = 16, vocab: int = 97) -> LayerGraph:
+    return gpt(4, 32, 2, seq_len, vocab=vocab, name="gpt_tiny")
+
+
+def gpt_stage_cuts(num_layers: int, num_stages: int) -> list[str]:
+    """Even block-boundary cut points for an ``num_stages``-stage pipeline."""
+    if not 1 <= num_stages <= num_layers:
+        raise ValueError(f"need 1 <= stages <= {num_layers}")
+    per = num_layers / num_stages
+    return [f"block_{round(per * (s + 1)) - 1}"
+            for s in range(num_stages - 1)]

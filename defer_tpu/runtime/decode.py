@@ -1,0 +1,311 @@
+"""Pipelined autoregressive decoding with per-stage KV caches.
+
+The inference engine (:mod:`defer_tpu.runtime.spmd`) streams independent
+inputs through the stage ring; generation is harder — token t+1 of a
+sequence cannot enter stage 0 until token t has left the last stage.  A
+single sequence would therefore keep only one of N stages busy.  The classic
+fix, implemented here: interleave N independent *groups* of sequences
+round-robin, so at every step stage k serves group ``(t - k) mod N`` — the
+ring is full and every device computes every step, DEFER's "all stages busy
+on different in-flight inputs" (SURVEY.md §0) transposed to token time.
+
+TPU-native design, one SPMD program:
+
+  * Weights: each device materializes only its stage's parameters from a
+    stage-sharded flat buffer (same scheme as ``SpmdPipeline``).
+  * KV caches: a per-device resident array ``[Lmax, N, mb, max_len+1, d]``
+    (local blocks x groups) in compute dtype; row ``max_len`` is a scratch
+    slot that warmup bubbles write into, so no masked read-modify-write of
+    the cache is ever needed.
+  * The ring carry is one ``[mb, d]`` float32 buffer per device: stage
+    activations in flight, and — on the wrap link from the last stage back
+    to stage 0 (the reference's node->dispatcher link,
+    src/dispatcher.py:51-55) — the greedily sampled token ids encoded in
+    column 0 (f32 is exact for ids < 2^24).
+  * ``lax.scan`` over decode steps fuses the whole token loop into one XLA
+    dispatch; prompt teacher-forcing happens inside the scan (stage 0
+    substitutes the known prompt token while ``pos < prompt_len``), so
+    prefill and generation are one program with zero host round trips.
+
+Scope (v1): greedy argmax sampling, stage-axis-only mesh, the ``gpt()``
+node-name contract (``embeddings`` / ``block_i`` / ``final_ln`` /
+``lm_head`` — models/gpt.py).  Prefill advances one token per group per N
+steps (decode-rate); a fused full-sequence prefill can seed the caches in a
+later revision.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graph.ir import LayerGraph
+from ..models.gpt import CausalTransformerBlock, GptEmbedding
+from ..parallel.mesh import STAGE_AXIS, pipeline_mesh
+from . import flatbuf
+
+
+def _split_blocks(num_blocks: int, num_stages: int) -> list[list[int]]:
+    """Contiguous, balanced block assignment (stage i gets ~L/N blocks)."""
+    bounds = [round(num_blocks * s / num_stages)
+              for s in range(num_stages + 1)]
+    out = [list(range(bounds[s], bounds[s + 1])) for s in range(num_stages)]
+    if any(not b for b in out):
+        raise ValueError(
+            f"{num_blocks} blocks cannot fill {num_stages} stages")
+    return out
+
+
+class PipelinedDecoder:
+    """Greedy autoregressive generation over a ``stage``-axis mesh.
+
+    Usage::
+
+        graph = gpt_tiny()
+        dec = PipelinedDecoder(graph, graph.init(key), num_stages=4,
+                               microbatch=2, max_len=32)
+        tokens = dec.generate(prompt_ids, max_new_tokens=16)
+
+    ``prompt_ids`` is [B, prompt_len] with B <= num_stages * microbatch;
+    returns [B, prompt_len + max_new_tokens].
+    """
+
+    def __init__(
+        self,
+        graph: LayerGraph,
+        params: dict[str, Any],
+        *,
+        num_stages: int,
+        max_len: int,
+        mesh: Mesh | None = None,
+        microbatch: int = 1,
+        compute_dtype=None,
+    ):
+        self.graph = graph
+        self.num_stages = n = num_stages
+        self.mesh = mesh if mesh is not None else pipeline_mesh(n)
+        if self.mesh.shape[STAGE_AXIS] != n:
+            raise ValueError(
+                f"mesh stage axis {self.mesh.shape[STAGE_AXIS]} != {n}")
+        self.microbatch = mb = microbatch
+        self.max_len = max_len
+        self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype \
+            else jnp.dtype(jnp.float32)
+
+        nodes = graph.nodes
+        for req in ("embeddings", "final_ln", "lm_head"):
+            if req not in nodes:
+                raise ValueError(
+                    f"decoder graphs must follow the gpt() node contract; "
+                    f"missing {req!r} (models/gpt.py)")
+        self.embed_op: GptEmbedding = nodes["embeddings"].op
+        if max_len > self.embed_op.max_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's positional table "
+                f"({self.embed_op.max_len})")
+        block_names = [nm for nm in graph.topo_order
+                       if nm.startswith("block_")]
+        self.block_names = block_names
+        for nm in block_names:
+            if not isinstance(nodes[nm].op, CausalTransformerBlock):
+                raise TypeError(f"{nm} is not a CausalTransformerBlock")
+        self.d_model = nodes[block_names[0]].out_spec.shape[-1]
+        self.vocab = nodes["lm_head"].out_spec.shape[-1]
+
+        assign = _split_blocks(len(block_names), n)
+        self.stage_blocks = [[block_names[i] for i in idxs]
+                             for idxs in assign]
+        self.l_max = max(len(b) for b in self.stage_blocks)
+
+        # --- stage-sharded flat weight buffer (scheme of runtime/spmd.py)
+        stage_param_names: list[list[str]] = []
+        for s in range(n):
+            names = list(self.stage_blocks[s])
+            if s == 0:
+                names.insert(0, "embeddings")
+            if s == n - 1:
+                names += ["final_ln", "lm_head"]
+            stage_param_names.append(names)
+        self._stage_param_names = stage_param_names
+
+        self._wmeta, self._wtreedef, flats = [], [], []
+        for names in stage_param_names:
+            sub = {nm: params[nm] for nm in names}
+            leaves, treedef = jax.tree.flatten(sub)
+            leaves = [np.asarray(l, np.float32) for l in leaves]
+            self._wmeta.append(flatbuf.leaf_meta(leaves))
+            self._wtreedef.append(treedef)
+            flats.append(flatbuf.pack_leaves(leaves, np.float32))
+        self._w = jax.device_put(
+            flatbuf.stack_rows(flats, np.float32),
+            NamedSharding(self.mesh, P(STAGE_AXIS, None)))
+
+        self._branches = [self._make_branch(s) for s in range(n)]
+        self._cache_shape = (self.l_max, n, mb, max_len + 1, self.d_model)
+        #: compiled decode programs keyed by scan length — repeat
+        #: ``generate`` calls of the same shape are dispatch-only
+        self._decode_fns: dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+
+    def _stage_params(self, s: int, w_local: jax.Array):
+        return flatbuf.unpack_leaves(w_local, self._wmeta[s],
+                                     self._wtreedef[s])
+
+    def _make_branch(self, s: int):
+        """Stage ``s``'s step: consume the ring buffer, update caches.
+
+        Uniform signature for ``lax.switch``:
+        ``(w_local, a, kc, vc, prompt, g, pos, plen) -> (a_out, kc, vc)``.
+        """
+        n = self.num_stages
+        nodes = self.graph.nodes
+        cd = self.compute_dtype
+        is_first, is_last = s == 0, s == n - 1
+        block_ops = [nodes[nm].op for nm in self.stage_blocks[s]]
+        embed_op = self.embed_op
+
+        def branch(w_local, a, kc, vc, prompt, g, pos, plen):
+            p = self._stage_params(s, w_local)
+            # bubble steps (pos < 0 during warmup skew) write the cache
+            # scratch row and attend over nothing real; their outputs are
+            # never read (host drops them by schedule index)
+            valid = pos >= 0
+            safe_pos = jnp.clip(pos, 0, self.max_len - 1)
+            write_pos = jnp.where(valid, safe_pos, self.max_len)
+
+            if is_first:
+                recv_ids = jnp.round(a[:, 0]).astype(jnp.int32)
+                prompt_ids = lax.dynamic_slice(
+                    prompt, (g, 0, jnp.minimum(safe_pos, prompt.shape[2] - 1)),
+                    (1, self.microbatch, 1))[0, :, 0]
+                ids = jnp.where(safe_pos < plen, prompt_ids, recv_ids)
+                x = embed_op.embed_at(p["embeddings"], ids, safe_pos)
+                x = x.astype(cd)
+            else:
+                x = a[:, : self.d_model].astype(cd)
+
+            for l, (nm, op) in enumerate(zip(self.stage_blocks[s],
+                                             block_ops)):
+                k_l = lax.dynamic_slice(
+                    kc, (l, g, 0, 0, 0),
+                    (1, 1) + self._cache_shape[2:])[0, 0]
+                v_l = lax.dynamic_slice(
+                    vc, (l, g, 0, 0, 0),
+                    (1, 1) + self._cache_shape[2:])[0, 0]
+                x, k_l, v_l = op.decode(p[nm], x, k_l, v_l, write_pos)
+                kc = lax.dynamic_update_slice(
+                    kc, k_l[None, None], (l, g, 0, 0, 0))
+                vc = lax.dynamic_update_slice(
+                    vc, v_l[None, None], (l, g, 0, 0, 0))
+
+            if is_last:
+                h = nodes["final_ln"].op.apply(p["final_ln"], x)
+                logits = nodes["lm_head"].op.apply(p["lm_head"], h)
+                ids = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                a_out = jnp.zeros((self.microbatch, self.d_model),
+                                  jnp.float32)
+                a_out = a_out.at[:, 0].set(ids.astype(jnp.float32))
+            else:
+                a_out = x.astype(jnp.float32)
+            return a_out, kc, vc
+
+        return branch
+
+    def _build_decode_fn(self, num_steps: int):
+        n = self.num_stages
+        perm = [(k, (k + 1) % n) for k in range(n)]
+        branches = self._branches
+        cd = self.compute_dtype
+        mb, d = self.microbatch, self.d_model
+
+        def device_decode(w, prompt, plen):
+            w_l = w[0]
+            idx = lax.axis_index(STAGE_AXIS)
+            a0 = jnp.zeros((mb, d), jnp.float32)
+            kc0 = jnp.zeros(self._cache_shape, cd)
+            vc0 = jnp.zeros(self._cache_shape, cd)
+
+            def body(carry, t):
+                a, kc, vc = carry
+                # stage idx serves group (t - idx) mod n at token position
+                # (t - idx) // n; negative during the warmup skew = bubble
+                rel = t - idx
+                g = jnp.where(rel >= 0, rel % n, 0)
+                pos = jnp.where(rel >= 0, rel // n, -1)
+                a_out, kc, vc = lax.switch(
+                    idx, branches, w_l, a, kc, vc, prompt, g, pos, plen)
+                a_next = lax.ppermute(a_out, STAGE_AXIS, perm)
+                # emit what just arrived on the wrap link: ids sampled by
+                # the last stage, readable on device 0 (runtime/spmd.py
+                # emits the same slice for the inference pipeline)
+                return (a_next, kc, vc), a_next[:, 0]
+
+            (_, _, _), ids = lax.scan(
+                body, (a0, kc0, vc0), jnp.arange(num_steps, dtype=jnp.int32))
+            return ids[None]  # [1, T, mb] per device
+
+        fn = jax.shard_map(
+            device_decode, mesh=self.mesh,
+            in_specs=(P(STAGE_AXIS, None), P(None, None, None), P()),
+            out_specs=P(STAGE_AXIS, None, None),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    # ------------------------------------------------------------------
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 ) -> np.ndarray:
+        """Greedy-decode ``max_new_tokens`` past each prompt.
+
+        ``prompt_ids``: [B, prompt_len] ints, B <= num_stages * microbatch
+        and B % microbatch == 0.  All prompts share one length (pad/bucket
+        upstream).  Returns [B, prompt_len + max_new_tokens].
+        """
+        prompt_ids = np.asarray(prompt_ids)
+        if prompt_ids.ndim != 2:
+            raise ValueError("prompt_ids must be [B, prompt_len]")
+        b, plen = prompt_ids.shape
+        if plen < 1:
+            raise ValueError("prompt must contain at least one token "
+                             "(position 0 has nothing to condition on)")
+        n, mb = self.num_stages, self.microbatch
+        if b % mb or not 0 < b <= n * mb:
+            raise ValueError(
+                f"B={b} must be a multiple of microbatch={mb} and at most "
+                f"num_stages*microbatch={n * mb}")
+        t_tok = plen + max_new_tokens
+        if t_tok > self.max_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {t_tok} exceeds "
+                f"max_len={self.max_len}")
+        groups = b // mb
+
+        prompt = np.zeros((n, mb, plen), np.int32)
+        prompt.reshape(n * mb, plen)[:b] = prompt_ids
+        # token at position p of group g is sampled by the last stage at
+        # scan step (n-1) + n*(p-1) + g and emitted that same step; the
+        # final needed position is t_tok - 1
+        num_steps = (n - 1) + n * (t_tok - 2) + (n - 1) + 1 if t_tok > 1 \
+            else n
+        fn = self._decode_fns.get(num_steps)
+        if fn is None:
+            fn = self._decode_fns[num_steps] = \
+                self._build_decode_fn(num_steps)
+        ids = np.asarray(jax.device_get(
+            fn(self._w, jnp.asarray(prompt), jnp.int32(plen))))[0]
+        # ids: [T, mb] from device 0's wrap link
+        out = np.zeros((n, mb, t_tok), np.int64)
+        out[:, :, :plen] = prompt[:, :, :plen]
+        for g in range(groups):
+            for p in range(max(1, plen), t_tok):
+                t = (n - 1) + n * (p - 1) + g
+                out[g, :, p] = ids[t].astype(np.int64)
+        return out.reshape(n * mb, t_tok)[:b]

@@ -47,6 +47,7 @@ from ..graph.ir import ShapeSpec
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS, pipeline_mesh
 from ..partition.stage import StageSpec, buffer_footprint
 from ..utils.metrics import PipelineMetrics
+from . import flatbuf
 
 
 class SpmdPipeline:
@@ -124,34 +125,23 @@ class SpmdPipeline:
                          else s.select_params(params))
                 leaves, treedef = jax.tree.flatten(shard)
                 if r == 0:
-                    meta, off = [], 0
-                    for leaf in leaves:
-                        leaf = np.asarray(leaf)
-                        meta.append((off, leaf.size, leaf.shape, leaf.dtype))
-                        off += leaf.size
-                    self._wmeta.append(meta)
+                    self._wmeta.append(flatbuf.leaf_meta(leaves))
                     self._wtreedef.append(treedef)
                     self._wreplicated.append(
                         [np.shape(l) == fs for l, fs
                          in zip(leaves, full_shapes)]
                         if full_shapes is not None
                         else [True] * len(leaves))
-                rank_flats.append(
-                    np.concatenate([self._to_wire(np.asarray(l), s.name)
-                                    .ravel() for l in leaves])
-                    if leaves else np.zeros((0,), wdt))
+                rank_flats.append(flatbuf.pack_leaves(
+                    leaves, wdt,
+                    cast_fn=lambda a, _nm=s.name: self._to_wire(a, _nm)))
             flats.append(rank_flats)
-        pmax = max(max((f.size for rf in flats for f in rf), default=1), 1)
         if tp > 1:
-            wbuf = np.zeros((n, tp, pmax), wdt)
-            for i, rf in enumerate(flats):
-                for r, f in enumerate(rf):
-                    wbuf[i, r, : f.size] = f
+            rows = [f for rf in flats for f in rf]
+            wbuf = flatbuf.stack_rows(rows, wdt).reshape(n, tp, -1)
             wspec = P(STAGE_AXIS, MODEL_AXIS, None)
         else:
-            wbuf = np.zeros((n, pmax), wdt)
-            for i, rf in enumerate(flats):
-                wbuf[i, : rf[0].size] = rf[0]
+            wbuf = flatbuf.stack_rows([rf[0] for rf in flats], wdt)
             wspec = P(STAGE_AXIS, None)
         self._wspec = wspec
         self._w = jax.device_put(wbuf, NamedSharding(self.mesh, wspec))
@@ -244,12 +234,7 @@ class SpmdPipeline:
             return dtype
 
         def branch(w_local, a_local):
-            leaves = [
-                lax.slice(w_local, (off,), (off + size,)).reshape(shape)
-                .astype(leaf_dtype(dtype))
-                for off, size, shape, dtype in meta
-            ]
-            p = jax.tree.unflatten(treedef, leaves)
+            p = flatbuf.unpack_leaves(w_local, meta, treedef, leaf_dtype)
             b = a_local.shape[0]
             x = a_local[:, :in_sz].reshape((b,) + in_shape).astype(x_dtype)
             y = stage.fn(p, x, tp_axis=MODEL_AXIS if tp > 1 else None, tp=tp)
