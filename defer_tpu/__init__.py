@@ -37,6 +37,7 @@ from .parallel.tensor import (MODEL_AXIS, shard_tp_params,
                               tensor_parallel_fn, tensor_parallel_mesh)
 from .partition.partitioner import partition
 from .partition.stage import StageSpec
+from .runtime.decode import PipelinedDecoder
 from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
 from .runtime.mpmd import MpmdPipeline
 from .runtime.spmd import SpmdPipeline
@@ -54,8 +55,8 @@ __all__ = [
     "partition", "valid_cut_points", "auto_cut_points", "total_flops",
     "summary", "to_dot",
     "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",
-    "SpmdPipeline", "MpmdPipeline", "PipelineTrainer", "Defer",
-    "DeferHandle", "DeferConfig",
+    "SpmdPipeline", "MpmdPipeline", "PipelineTrainer", "PipelinedDecoder",
+    "Defer", "DeferHandle", "DeferConfig",
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
     "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",
     "sequence_parallel_attention_ulysses", "ulysses_attention",
