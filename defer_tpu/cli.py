@@ -227,6 +227,40 @@ def cmd_train(args):
                       "steps": args.steps, "losses": losses}))
 
 
+def cmd_generate(args):
+    """Pipelined autoregressive generation demo (random prompts)."""
+    import jax
+
+    from .runtime.decode import PipelinedDecoder
+
+    graph = _get_model(args.model)
+    if "lm_head" not in graph.nodes:
+        raise SystemExit(f"{args.model} is not a decoder model; use one of "
+                         "the gpt* families")
+    params = graph.init(jax.random.key(0))
+    vocab = graph.nodes["lm_head"].out_spec.shape[-1]
+    max_len = graph.nodes["embeddings"].op.max_len
+    dec = PipelinedDecoder(graph, params, num_stages=args.stages,
+                           microbatch=args.microbatch, max_len=max_len)
+    rng = np.random.default_rng(args.seed)
+    b = args.stages * args.microbatch
+    prompt = rng.integers(0, vocab, (b, args.prompt_len)).astype(np.int32)
+    kw = dict(temperature=args.temperature, top_k=args.top_k,
+              seed=args.seed, prefill=args.prefill,
+              token_chunk=args.token_chunk)
+    dec.generate(prompt, args.new_tokens, **kw)   # compile
+    t0 = time.perf_counter()
+    toks = dec.generate(prompt, args.new_tokens, **kw)   # warm
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "model": args.model, "stages": args.stages,
+        "batch": b, "prompt_len": args.prompt_len,
+        "new_tokens": args.new_tokens, "prefill": args.prefill,
+        "tokens_per_s": round(b * args.new_tokens / dt, 2),
+        "first_row": toks[0].tolist(),
+    }))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m defer_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -289,10 +323,25 @@ def main(argv=None):
                    help="int8: train the quantized deployment (STE)")
     t.add_argument("--save", help="write a training checkpoint here")
 
+    g = sub.add_parser("generate", help="pipelined autoregressive "
+                                        "generation demo (gpt models)")
+    g.add_argument("--model", default="gpt_tiny")
+    g.add_argument("--stages", type=int, default=4)
+    g.add_argument("--microbatch", type=int, default=2)
+    g.add_argument("--prompt-len", type=int, default=4)
+    g.add_argument("--new-tokens", type=int, default=8)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=None)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--prefill", action="store_true",
+                   help="fused full-sequence prompt prefill")
+    g.add_argument("--token-chunk", type=int, default=None)
+
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition,
      "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
-     "chain": cmd_chain, "train": cmd_train}[args.cmd](args)
+     "chain": cmd_chain, "train": cmd_train,
+     "generate": cmd_generate}[args.cmd](args)
 
 
 if __name__ == "__main__":

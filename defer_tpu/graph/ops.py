@@ -427,6 +427,15 @@ class TransformerBlock(Op):
         return jnp.einsum("bhqk,bhkd->bhqd", att, v)
 
     def apply(self, params, x):
+        return self.apply_with_kv(params, x)[0]
+
+    def apply_with_kv(self, params, x):
+        """Forward that also returns the raw K/V projections [b, t, d].
+
+        The single definition of the block forward — ``apply`` discards the
+        byproducts (XLA dead-code-eliminates them); decode-cache seeding
+        (models/gpt.py prefill) consumes them.
+        """
         p = _cast(params, x.dtype)
         b, t, d = x.shape
         nh = self.num_heads
@@ -435,16 +444,16 @@ class TransformerBlock(Op):
         y = self._ln(p["ln1"], x)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        y = self._attend(q, k, v)
+        qh = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        kh = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        y = self._attend(qh, kh, vh)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 
         y = self._ln(p["ln2"], x)
         y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
-        return x + (y @ p["fc2"]["w"] + p["fc2"]["b"])
+        return x + (y @ p["fc2"]["w"] + p["fc2"]["b"]), k, v
 
     def flops(self, in_specs, out_spec):
         (spec,) = in_specs

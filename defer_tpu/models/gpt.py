@@ -54,36 +54,48 @@ class CausalTransformerBlock(TransformerBlock):
         att = jax.nn.softmax(att, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", att, v)
 
+    # apply/apply_with_kv are inherited: the base TransformerBlock forward
+    # (graph/ops.py) is the single implementation, made causal here purely
+    # through the _attend override above.  apply_with_kv's K/V columns
+    # match what decode() writes row-by-row (pre-head-split qkv
+    # projections), so pipelined prefill bulk-writes cache rows 0..t-1
+    # (after the head-major relayout) and decoding continues at t.
+
     def decode(self, params, x, k_cache, v_cache, pos):
         """One-token step: ``x`` [b, d] at position ``pos``.
 
-        ``k_cache``/``v_cache`` are [b, L, d] with L > max position; the new
-        key/value row is written at ``pos`` (callers pass a clamped scratch
-        index for bubble steps) and attention covers positions <= ``pos``.
-        Returns ``(y [b, d], k_cache, v_cache)``.
+        ``k_cache``/``v_cache`` are **head-major** [b, nh, L, hd] with
+        L > max position — heads lead so the attention contractions are
+        plain batched dots; a position-major [b, L, d] layout would make
+        XLA materialize a transpose of the whole cache every step.  The
+        new key/value row is written at ``pos`` (callers pass a clamped
+        scratch index for bubble steps) and attention covers positions
+        <= ``pos``.  Returns ``(y [b, d], k_cache, v_cache)``.
         """
         p = _cast(params, x.dtype)
         b, d = x.shape
         nh = self.num_heads
         hd = d // nh
-        cache_len = k_cache.shape[1]
+        cache_len = k_cache.shape[2]
 
         y = self._ln(p["ln1"], x)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
         q, k_new, v_new = jnp.split(qkv, 3, axis=-1)       # [b, d] each
         k_cache = lax.dynamic_update_slice(
-            k_cache, k_new[:, None, :].astype(k_cache.dtype), (0, pos, 0))
+            k_cache, k_new.reshape(b, nh, 1, hd).astype(k_cache.dtype),
+            (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(
-            v_cache, v_new[:, None, :].astype(v_cache.dtype), (0, pos, 0))
+            v_cache, v_new.reshape(b, nh, 1, hd).astype(v_cache.dtype),
+            (0, 0, pos, 0))
 
         qh = q.reshape(b, nh, hd)
-        kh = k_cache.astype(x.dtype).reshape(b, cache_len, nh, hd)
-        vh = v_cache.astype(x.dtype).reshape(b, cache_len, nh, hd)
-        att = jnp.einsum("bhd,blhd->bhl", qh, kh) / math.sqrt(hd)
+        kh = k_cache.astype(x.dtype)
+        vh = v_cache.astype(x.dtype)
+        att = jnp.einsum("bhd,bhld->bhl", qh, kh) / math.sqrt(hd)
         live = jnp.arange(cache_len)[None, None, :] <= pos
         att = jnp.where(live, att, jnp.asarray(-jnp.inf, att.dtype))
         att = jax.nn.softmax(att, axis=-1)
-        y = jnp.einsum("bhl,blhd->bhd", att, vh).reshape(b, d)
+        y = jnp.einsum("bhl,bhld->bhd", att, vh).reshape(b, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 
         y = self._ln(p["ln2"], x)

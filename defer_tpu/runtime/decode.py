@@ -12,26 +12,37 @@ on different in-flight inputs" (SURVEY.md §0) transposed to token time.
 TPU-native design, one SPMD program:
 
   * Weights: each device materializes only its stage's parameters from a
-    stage-sharded flat buffer (same scheme as ``SpmdPipeline``).
-  * KV caches: a per-device resident array ``[Lmax, N, mb, max_len+1, d]``
-    (local blocks x groups) in compute dtype; row ``max_len`` is a scratch
-    slot that warmup bubbles write into, so no masked read-modify-write of
-    the cache is ever needed.
+    stage-sharded flat buffer (same scheme as ``SpmdPipeline``), stored in
+    the compute dtype.
+  * KV caches: a per-device resident array
+    ``[Lmax, N+1, mb, nh, max_len+1, hd]`` (local blocks x groups,
+    head-major so attention needs no per-step cache transpose) in compute
+    dtype; position row ``max_len`` is a scratch slot that warmup bubbles
+    write into, and group slot ``N`` absorbs prefill bubbles — so no
+    masked read-modify-write of the cache is ever needed.
   * The ring carry is one ``[mb, d]`` float32 buffer per device: stage
     activations in flight, and — on the wrap link from the last stage back
     to stage 0 (the reference's node->dispatcher link,
     src/dispatcher.py:51-55) — the greedily sampled token ids encoded in
     column 0 (f32 is exact for ids < 2^24).
-  * ``lax.scan`` over decode steps fuses the whole token loop into one XLA
-    dispatch; prompt teacher-forcing happens inside the scan (stage 0
-    substitutes the known prompt token while ``pos < prompt_len``), so
-    prefill and generation are one program with zero host round trips.
+  * ``lax.scan`` over decode steps fuses the token loop into chunked XLA
+    dispatches (``token_chunk`` tokens per group per dispatch, whole
+    generation in ONE dispatch by default); prompt teacher-forcing happens
+    inside the scan (stage 0 substitutes the known prompt token while
+    ``pos < prompt_len``), and the ring carry + caches flow between
+    dispatches as donated device-resident shards — zero host round trips
+    except the optional EOS check.
+  * Sampling: greedy argmax, or temperature softmax sampling with optional
+    top-k, keyed by ``fold_in(seed, step)`` so results are independent of
+    the chunking.
 
-Scope (v1): greedy argmax sampling, stage-axis-only mesh, the ``gpt()``
-node-name contract (``embeddings`` / ``block_i`` / ``final_ln`` /
-``lm_head`` — models/gpt.py).  Prefill advances one token per group per N
-steps (decode-rate); a fused full-sequence prefill can seed the caches in a
-later revision.
+Scope: stage-axis-only mesh, the ``gpt()`` node-name contract
+(``embeddings`` / ``block_i`` / ``final_ln`` / ``lm_head`` —
+models/gpt.py).  Prompts are processed either at decode rate (teacher
+forcing inside the scan, the default) or by the fused full-sequence
+pipelined prefill (``generate(..., prefill=True)``): each group's whole
+prompt crosses each stage in one causal-attention step and bulk-seeds the
+caches, dropping prompt cost from ``plen * N`` ring steps to ``2N - 1``.
 """
 
 from __future__ import annotations
@@ -49,6 +60,18 @@ from ..graph.ir import LayerGraph
 from ..models.gpt import CausalTransformerBlock, GptEmbedding
 from ..parallel.mesh import STAGE_AXIS, pipeline_mesh
 from . import flatbuf
+
+
+def _sample_ids(logits, temp, top_k, step_key):
+    """Temperature softmax sampling with optional top-k truncation.
+
+    The single definition shared by the decode and prefill branches — both
+    must draw from the identical distribution."""
+    lg = logits / jnp.maximum(temp, 1e-6)
+    if top_k is not None:
+        kth = lax.top_k(lg, top_k)[0][:, -1:]
+        lg = jnp.where(lg >= kth, lg, -jnp.inf)
+    return jax.random.categorical(step_key, lg, axis=-1)
 
 
 def _split_blocks(num_blocks: int, num_stages: int) -> list[list[int]]:
@@ -116,6 +139,8 @@ class PipelinedDecoder:
             if not isinstance(nodes[nm].op, CausalTransformerBlock):
                 raise TypeError(f"{nm} is not a CausalTransformerBlock")
         self.d_model = nodes[block_names[0]].out_spec.shape[-1]
+        self.num_heads = nodes[block_names[0]].op.num_heads
+        self.head_dim = self.d_model // self.num_heads
         self.vocab = nodes["lm_head"].out_spec.shape[-1]
 
         assign = _split_blocks(len(block_names), n)
@@ -134,23 +159,35 @@ class PipelinedDecoder:
             stage_param_names.append(names)
         self._stage_param_names = stage_param_names
 
+        # weights live in the compute dtype (the runtime/spmd.py recipe):
+        # bf16 deployments read 2 bytes/param from HBM per decode step with
+        # no per-step downcast materialization
+        wdt = np.dtype(jnp.bfloat16) if self.compute_dtype == jnp.bfloat16 \
+            else np.float32
         self._wmeta, self._wtreedef, flats = [], [], []
         for names in stage_param_names:
             sub = {nm: params[nm] for nm in names}
             leaves, treedef = jax.tree.flatten(sub)
-            leaves = [np.asarray(l, np.float32) for l in leaves]
+            leaves = [np.asarray(l).astype(wdt) for l in leaves]
             self._wmeta.append(flatbuf.leaf_meta(leaves))
             self._wtreedef.append(treedef)
-            flats.append(flatbuf.pack_leaves(leaves, np.float32))
+            flats.append(flatbuf.pack_leaves(leaves, wdt))
         self._w = jax.device_put(
-            flatbuf.stack_rows(flats, np.float32),
+            flatbuf.stack_rows(flats, wdt),
             NamedSharding(self.mesh, P(STAGE_AXIS, None)))
 
-        self._branches = [self._make_branch(s) for s in range(n)]
-        self._cache_shape = (self.l_max, n, mb, max_len + 1, self.d_model)
-        #: compiled decode programs keyed by scan length — repeat
-        #: ``generate`` calls of the same shape are dispatch-only
-        self._decode_fns: dict[int, Any] = {}
+        # group axis is n+1: slot n is the scratch group that pipelined
+        # prefill's warmup/drain bubbles write into (the group-axis twin of
+        # the max_len scratch row).  Head-major position axis per the
+        # CausalTransformerBlock.decode cache contract.
+        self._cache_shape = (self.l_max, n + 1, mb, self.num_heads,
+                             max_len + 1, self.head_dim)
+        #: compiled decode programs keyed by (chunk_steps, sample, top_k) —
+        #: repeat ``generate`` calls of a matching shape are dispatch-only
+        self._decode_fns: dict[tuple, Any] = {}
+        #: compiled prefill programs keyed by (prompt_len, sample, top_k)
+        self._prefill_fns: dict[tuple, Any] = {}
+        self._init_fn = None  # cached jitted state initializer
 
     # ------------------------------------------------------------------
 
@@ -158,11 +195,12 @@ class PipelinedDecoder:
         return flatbuf.unpack_leaves(w_local, self._wmeta[s],
                                      self._wtreedef[s])
 
-    def _make_branch(self, s: int):
+    def _make_branch(self, s: int, sample: bool, top_k: int | None):
         """Stage ``s``'s step: consume the ring buffer, update caches.
 
         Uniform signature for ``lax.switch``:
-        ``(w_local, a, kc, vc, prompt, g, pos, plen) -> (a_out, kc, vc)``.
+        ``(w_local, a, kc, vc, prompt, g, pos, plen, t, seed, temp)
+        -> (a_out, kc, vc)``.
         """
         n = self.num_stages
         nodes = self.graph.nodes
@@ -171,12 +209,14 @@ class PipelinedDecoder:
         block_ops = [nodes[nm].op for nm in self.stage_blocks[s]]
         embed_op = self.embed_op
 
-        def branch(w_local, a, kc, vc, prompt, g, pos, plen):
+        def branch(w_local, a, kc, vc, prompt, g, pos, plen, t, seed, temp,
+                   first_ids, first_pos):
             p = self._stage_params(s, w_local)
-            # bubble steps (pos < 0 during warmup skew) write the cache
-            # scratch row and attend over nothing real; their outputs are
-            # never read (host drops them by schedule index)
-            valid = pos >= 0
+            # bubble steps (pos < 0 during warmup skew, or pos >= max_len
+            # on chunk-overshoot steps past the requested generation) write
+            # the cache scratch row and attend over nothing real; their
+            # outputs are never read (host drops them by schedule index)
+            valid = jnp.logical_and(pos >= 0, pos < self.max_len)
             safe_pos = jnp.clip(pos, 0, self.max_len - 1)
             write_pos = jnp.where(valid, safe_pos, self.max_len)
 
@@ -186,6 +226,11 @@ class PipelinedDecoder:
                     prompt, (g, 0, jnp.minimum(safe_pos, prompt.shape[2] - 1)),
                     (1, self.microbatch, 1))[0, :, 0]
                 ids = jnp.where(safe_pos < plen, prompt_ids, recv_ids)
+                # after a fused prefill the first generated token comes from
+                # the prefill program, not the ring (first_pos = -1 disables)
+                fi = lax.dynamic_slice(first_ids, (g, 0),
+                                       (1, self.microbatch))[0]
+                ids = jnp.where(safe_pos == first_pos, fi, ids)
                 x = embed_op.embed_at(p["embeddings"], ids, safe_pos)
                 x = x.astype(cd)
             else:
@@ -194,21 +239,29 @@ class PipelinedDecoder:
             for l, (nm, op) in enumerate(zip(self.stage_blocks[s],
                                              block_ops)):
                 k_l = lax.dynamic_slice(
-                    kc, (l, g, 0, 0, 0),
+                    kc, (l, g, 0, 0, 0, 0),
                     (1, 1) + self._cache_shape[2:])[0, 0]
                 v_l = lax.dynamic_slice(
-                    vc, (l, g, 0, 0, 0),
+                    vc, (l, g, 0, 0, 0, 0),
                     (1, 1) + self._cache_shape[2:])[0, 0]
                 x, k_l, v_l = op.decode(p[nm], x, k_l, v_l, write_pos)
                 kc = lax.dynamic_update_slice(
-                    kc, k_l[None, None], (l, g, 0, 0, 0))
+                    kc, k_l[None, None], (l, g, 0, 0, 0, 0))
                 vc = lax.dynamic_update_slice(
-                    vc, v_l[None, None], (l, g, 0, 0, 0))
+                    vc, v_l[None, None], (l, g, 0, 0, 0, 0))
 
             if is_last:
                 h = nodes["final_ln"].op.apply(p["final_ln"], x)
-                logits = nodes["lm_head"].op.apply(p["lm_head"], h)
-                ids = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                logits = nodes["lm_head"].op.apply(
+                    p["lm_head"], h).astype(jnp.float32)
+                if sample:
+                    # keyed by the global step so results are identical
+                    # under any dispatch chunking; rows draw independently
+                    ids = _sample_ids(
+                        logits, temp, top_k,
+                        jax.random.fold_in(jax.random.PRNGKey(seed), t))
+                else:
+                    ids = jnp.argmax(logits, axis=-1)
                 a_out = jnp.zeros((self.microbatch, self.d_model),
                                   jnp.float32)
                 a_out = a_out.at[:, 0].set(ids.astype(jnp.float32))
@@ -218,56 +271,241 @@ class PipelinedDecoder:
 
         return branch
 
-    def _build_decode_fn(self, num_steps: int):
+    def _make_prefill_branch(self, s: int, plen: int, sample: bool,
+                             top_k: int | None):
+        """Stage ``s``'s pipelined-prefill step: one whole prompt group.
+
+        The group's full [mb, plen] prompt flows through the stages like
+        one inference microbatch; each block runs full-sequence causal
+        attention (``apply_with_kv``) and bulk-writes cache rows
+        ``0..plen-1``; the last stage emits the first generated token
+        (position ``plen``).  Bubble steps (g outside [0, n)) write the
+        scratch group ``n``.
+        """
         n = self.num_stages
-        perm = [(k, (k + 1) % n) for k in range(n)]
-        branches = self._branches
+        nodes = self.graph.nodes
         cd = self.compute_dtype
         mb, d = self.microbatch, self.d_model
+        is_first, is_last = s == 0, s == n - 1
+        embed_op = self.embed_op
 
-        def device_decode(w, prompt, plen):
+        def branch(w_local, a, kc, vc, prompt, g, seed, temp):
+            p = self._stage_params(s, w_local)
+            valid = jnp.logical_and(g >= 0, g < n)
+            safe_g = jnp.clip(g, 0, n - 1)
+            write_g = jnp.where(valid, safe_g, n)  # scratch group
+
+            if is_first:
+                ids = lax.dynamic_slice(prompt, (safe_g, 0, 0),
+                                        (1, mb, plen))[0]
+                x = embed_op.apply(p["embeddings"], ids).astype(cd)
+            else:
+                x = a.reshape(mb, plen, d).astype(cd)
+
+            nh, hd = self.num_heads, self.head_dim
+            for l, nm in enumerate(self.stage_blocks[s]):
+                x, k, v = nodes[nm].op.apply_with_kv(p[nm], x)
+                # head-major relayout (one transpose per prompt, amortized)
+                k = k.reshape(mb, plen, nh, hd).transpose(0, 2, 1, 3)
+                v = v.reshape(mb, plen, nh, hd).transpose(0, 2, 1, 3)
+                kc = lax.dynamic_update_slice(
+                    kc, k[None, None].astype(kc.dtype),
+                    (l, write_g, 0, 0, 0, 0))
+                vc = lax.dynamic_update_slice(
+                    vc, v[None, None].astype(vc.dtype),
+                    (l, write_g, 0, 0, 0, 0))
+
+            if is_last:
+                h = nodes["final_ln"].op.apply(p["final_ln"], x[:, -1])
+                logits = nodes["lm_head"].op.apply(
+                    p["lm_head"], h).astype(jnp.float32)
+                if sample:
+                    # key domain disjoint from decode's per-step keys
+                    ids = _sample_ids(
+                        logits, temp, top_k,
+                        jax.random.fold_in(jax.random.PRNGKey(seed),
+                                           (1 << 30) + safe_g))
+                else:
+                    ids = jnp.argmax(logits, axis=-1)
+                a_out = jnp.zeros((mb, plen * d), jnp.float32)
+                a_out = a_out.at[:, 0].set(ids.astype(jnp.float32))
+            else:
+                a_out = x.reshape(mb, plen * d).astype(jnp.float32)
+            return a_out, kc, vc
+
+        return branch
+
+    def _build_prefill_fn(self, plen: int, sample: bool, top_k: int | None):
+        n = self.num_stages
+        perm = [(k, (k + 1) % n) for k in range(n)]
+        branches = [self._make_prefill_branch(s, plen, sample, top_k)
+                    for s in range(n)]
+        mb, d = self.microbatch, self.d_model
+        num_steps = 2 * n - 1  # n groups through n stages, pipelined
+
+        def device_prefill(w, prompt, seed, temp, kc, vc):
             w_l = w[0]
             idx = lax.axis_index(STAGE_AXIS)
-            a0 = jnp.zeros((mb, d), jnp.float32)
-            kc0 = jnp.zeros(self._cache_shape, cd)
-            vc0 = jnp.zeros(self._cache_shape, cd)
+            a0 = jnp.zeros((mb, plen * d), jnp.float32)
+
+            def body(carry, t):
+                a, kc, vc = carry
+                g = t - idx  # stage idx prefills group t - idx
+                a_out, kc, vc = lax.switch(
+                    idx, branches, w_l, a, kc, vc, prompt, g, seed, temp)
+                a_next = lax.ppermute(a_out, STAGE_AXIS, perm)
+                return (a_next, kc, vc), a_next[:, 0]
+
+            (_, kc, vc), ids = lax.scan(
+                body, (a0, kc[0], vc[0]),
+                jnp.arange(num_steps, dtype=jnp.int32))
+            return kc[None], vc[None], ids[None]
+
+        state = P(STAGE_AXIS, None, None, None, None, None, None)
+        fn = jax.shard_map(
+            device_prefill, mesh=self.mesh,
+            in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
+                      state, state),
+            out_specs=(state, state, P(STAGE_AXIS, None, None)),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(4, 5))
+
+    def _init_state(self):
+        """Fresh sharded pipeline state: ring carry + empty KV caches.
+
+        The zero-fill programs are jitted ONCE and cached — a fresh lambda
+        per call would recompile (~0.4 s each) on every ``generate``.
+        """
+        if self._init_fn is None:
+            n, mb, d = self.num_stages, self.microbatch, self.d_model
+            cd = self.compute_dtype
+            act_sh = NamedSharding(self.mesh, P(STAGE_AXIS, None, None))
+            cache_sh = NamedSharding(
+                self.mesh, P(STAGE_AXIS, None, None, None, None, None))
+
+            def zeros():
+                return (jnp.zeros((n, mb, d), jnp.float32),
+                        jnp.zeros((n,) + self._cache_shape, cd),
+                        jnp.zeros((n,) + self._cache_shape, cd))
+
+            self._init_fn = jax.jit(
+                zeros, out_shardings=(act_sh, cache_sh, cache_sh))
+        return self._init_fn()
+
+    def _build_decode_fn(self, chunk_steps: int, sample: bool,
+                         top_k: int | None):
+        n = self.num_stages
+        perm = [(k, (k + 1) % n) for k in range(n)]
+        branches = [self._make_branch(s, sample, top_k) for s in range(n)]
+
+        def device_decode(w, prompt, plen, t0, seed, temp, first_ids,
+                          first_pos, start, a, kc, vc):
+            w_l = w[0]
+            idx = lax.axis_index(STAGE_AXIS)
 
             def body(carry, t):
                 a, kc, vc = carry
                 # stage idx serves group (t - idx) mod n at token position
-                # (t - idx) // n; negative during the warmup skew = bubble
+                # start + (t - idx)//n; negative skew = warmup bubble
                 rel = t - idx
                 g = jnp.where(rel >= 0, rel % n, 0)
-                pos = jnp.where(rel >= 0, rel // n, -1)
+                pos = jnp.where(rel >= 0, start + rel // n, -1)
                 a_out, kc, vc = lax.switch(
-                    idx, branches, w_l, a, kc, vc, prompt, g, pos, plen)
+                    idx, branches, w_l, a, kc, vc, prompt, g, pos, plen,
+                    t, seed, temp, first_ids, first_pos)
                 a_next = lax.ppermute(a_out, STAGE_AXIS, perm)
                 # emit what just arrived on the wrap link: ids sampled by
                 # the last stage, readable on device 0 (runtime/spmd.py
                 # emits the same slice for the inference pipeline)
                 return (a_next, kc, vc), a_next[:, 0]
 
-            (_, _, _), ids = lax.scan(
-                body, (a0, kc0, vc0), jnp.arange(num_steps, dtype=jnp.int32))
-            return ids[None]  # [1, T, mb] per device
+            (a, kc, vc), ids = lax.scan(
+                body, (a[0], kc[0], vc[0]),
+                t0 + jnp.arange(chunk_steps, dtype=jnp.int32))
+            return a[None], kc[None], vc[None], ids[None]
 
+        state = P(STAGE_AXIS, None, None, None, None, None, None)
         fn = jax.shard_map(
             device_decode, mesh=self.mesh,
-            in_specs=(P(STAGE_AXIS, None), P(None, None, None), P()),
-            out_specs=P(STAGE_AXIS, None, None),
+            in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
+                      P(), P(), P(None, None), P(), P(),
+                      P(STAGE_AXIS, None, None), state, state),
+            out_specs=(P(STAGE_AXIS, None, None), state, state,
+                       P(STAGE_AXIS, None, None)),
             check_vma=False,
         )
-        return jax.jit(fn)
+        # donate the carried state so chunked dispatches update in place
+        return jax.jit(fn, donate_argnums=(9, 10, 11))
 
     # ------------------------------------------------------------------
 
-    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                 ) -> np.ndarray:
-        """Greedy-decode ``max_new_tokens`` past each prompt.
+    def _gather_init(self, prompt: np.ndarray, plen: int, t_tok: int,
+                     start: int,
+                     first_ids: np.ndarray | None) -> tuple[np.ndarray, int]:
+        """Token output skeleton + the first position decode steps fill."""
+        n, mb = self.num_stages, self.microbatch
+        out = np.zeros((n, mb, t_tok), np.int64)
+        out[:, :, :plen] = prompt[:, :, :plen]
+        if first_ids is not None and start < t_tok:
+            out[:, :, start] = first_ids.astype(np.int64)
+            return out, start + 1
+        return out, max(1, plen)
+
+    def _gather_into(self, out: np.ndarray, ids_steps: np.ndarray,
+                     t0: int, t_tok: int, start: int, p0: int) -> None:
+        """Scatter one chunk of emitted wrap-link ids into ``out``.
+
+        Each decode scan step t >= n-1 emits exactly one (group, position):
+        ``g = (t - (n-1)) % n``, ``p = start + 1 + (t - (n-1) - g) // n``
+        — the inverse of "token p of group g is sampled at step
+        (n-1) + n*(p-1-start) + g".  O(chunk) per call, so chunked EOS
+        checking stays linear in the total step count.
+        """
+        n = self.num_stages
+        for i in range(ids_steps.shape[0]):
+            t = t0 + i
+            if t < n - 1:
+                continue
+            g = (t - (n - 1)) % n
+            p = start + 1 + (t - (n - 1) - g) // n
+            if p0 <= p < t_tok:
+                out[g, :, p] = ids_steps[i].astype(np.int64)
+
+    def _gather(self, ids_steps: np.ndarray, prompt: np.ndarray,
+                plen: int, t_tok: int, start: int = 0,
+                first_ids: np.ndarray | None = None) -> np.ndarray:
+        """Map emitted wrap-link ids back to (group, position) order."""
+        out, p0 = self._gather_init(prompt, plen, t_tok, start, first_ids)
+        self._gather_into(out, ids_steps, 0, t_tok, start, p0)
+        return out
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 seed: int = 0, eos_id: int | None = None,
+                 token_chunk: int | None = None,
+                 prefill: bool = False) -> np.ndarray:
+        """Decode ``max_new_tokens`` past each prompt.
 
         ``prompt_ids``: [B, prompt_len] ints, B <= num_stages * microbatch
         and B % microbatch == 0.  All prompts share one length (pad/bucket
         upstream).  Returns [B, prompt_len + max_new_tokens].
+
+        ``temperature=0`` is greedy argmax; ``temperature>0`` samples the
+        softmax (optionally truncated to ``top_k``), keyed by
+        ``(seed, step)`` so results do not depend on dispatch chunking.
+        ``token_chunk`` splits the scan into dispatches of that many tokens
+        per group (one compiled program serves every generation length);
+        the default is the whole generation in one dispatch.  ``eos_id``
+        stops early once every sequence has emitted it and fills the tail
+        with ``eos_id``.
+
+        ``prefill=True`` seeds the KV caches with a fused full-sequence
+        pipelined pass (each group's whole prompt crosses each stage in
+        ONE causal-attention step) instead of decode-rate teacher forcing:
+        prompt cost drops from ``plen * n`` ring steps to ``2n - 1``.
+        Greedy results are identical up to float reduction order; sampled
+        results use a different key for the first generated token.
         """
         prompt_ids = np.asarray(prompt_ids)
         if prompt_ids.ndim != 2:
@@ -286,26 +524,90 @@ class PipelinedDecoder:
             raise ValueError(
                 f"prompt_len + max_new_tokens = {t_tok} exceeds "
                 f"max_len={self.max_len}")
-        groups = b // mb
 
         prompt = np.zeros((n, mb, plen), np.int32)
         prompt.reshape(n * mb, plen)[:b] = prompt_ids
-        # token at position p of group g is sampled by the last stage at
-        # scan step (n-1) + n*(p-1) + g and emitted that same step; the
-        # final needed position is t_tok - 1
-        num_steps = (n - 1) + n * (t_tok - 2) + (n - 1) + 1 if t_tok > 1 \
-            else n
-        fn = self._decode_fns.get(num_steps)
+        if t_tok == plen:
+            return prompt.reshape(n * mb, plen)[:b].astype(np.int64)
+        sample = float(temperature) > 0.0
+        if not sample:
+            top_k = None  # unused by argmax; keep the program caches keyed
+            # identically so greedy calls never recompile over it
+        prompt_dev = jnp.asarray(prompt)
+        plen_s = jnp.int32(plen)
+        seed_s = jnp.uint32(seed)
+        temp_s = jnp.float32(temperature)
+        a, kc, vc = self._init_state()
+
+        if prefill:
+            pkey = (plen, sample, top_k)
+            pfn = self._prefill_fns.get(pkey)
+            if pfn is None:
+                pfn = self._prefill_fns[pkey] = \
+                    self._build_prefill_fn(plen, sample, top_k)
+            kc, vc, pre_ids = pfn(self._w, prompt_dev, seed_s, temp_s,
+                                  kc, vc)
+            # group g's first generated token exits the wrap link at
+            # prefill step g + (n-1)
+            pre_np = np.asarray(pre_ids[0])
+            first_ids_np = np.stack(
+                [pre_np[g + n - 1] for g in range(n)]).astype(np.int32)
+            start = plen
+        else:
+            first_ids_np = None
+            start = 0
+
+        # last needed decode step: position t_tok-1 of the last group
+        # (see _gather); with prefill, position `start` is already known
+        num_steps = (n - 1) + n * (t_tok - 2 - start) + (n - 1) + 1 \
+            if t_tok - 1 > start else 0
+        chunk_steps = max(num_steps, n) if token_chunk is None \
+            else max(n, n * int(token_chunk))
+
+        cache_key = (chunk_steps, sample, top_k)
+        fn = self._decode_fns.get(cache_key)
         if fn is None:
-            fn = self._decode_fns[num_steps] = \
-                self._build_decode_fn(num_steps)
-        ids = np.asarray(jax.device_get(
-            fn(self._w, jnp.asarray(prompt), jnp.int32(plen))))[0]
-        # ids: [T, mb] from device 0's wrap link
-        out = np.zeros((n, mb, t_tok), np.int64)
-        out[:, :, :plen] = prompt[:, :, :plen]
-        for g in range(groups):
-            for p in range(max(1, plen), t_tok):
-                t = (n - 1) + n * (p - 1) + g
-                out[g, :, p] = ids[t].astype(np.int64)
-        return out.reshape(n * mb, t_tok)[:b]
+            fn = self._decode_fns[cache_key] = \
+                self._build_decode_fn(chunk_steps, sample, top_k)
+
+        fi_dev = jnp.asarray(first_ids_np if first_ids_np is not None
+                             else np.zeros((n, mb), np.int32))
+        fp_s = jnp.int32(plen if prefill else -1)
+        start_s = jnp.int32(start)
+        chunks: list = []  # device chunks (no-eos path), drained at the end
+        out3, p0 = self._gather_init(prompt, plen, t_tok, start,
+                                     first_ids_np)
+        steps_run = 0
+        while steps_run < num_steps:
+            a, kc, vc, ids = fn(self._w, prompt_dev, plen_s,
+                                jnp.int32(steps_run), seed_s, temp_s,
+                                fi_dev, fp_s, start_s, a, kc, vc)
+            if eos_id is not None:
+                # incremental scatter of just this chunk: linear host work
+                self._gather_into(out3, np.asarray(ids[0]), steps_run,
+                                  t_tok, start, p0)
+            else:
+                chunks.append(ids)
+            steps_run += chunk_steps
+            if eos_id is not None:
+                # positions already decodable for EVERY group this far
+                p_avail = start + min(
+                    (steps_run - 1 - (n - 1) - g) // n + 1
+                    for g in range(n))
+                p_avail = min(p_avail, t_tok - 1)
+                flat = out3.reshape(n * mb, t_tok)[:b]
+                if p_avail >= plen and np.all(
+                        (flat[:, plen: p_avail + 1] == eos_id).any(axis=1)):
+                    break
+        for i, c in enumerate(chunks):  # no-eos path: one pass at the end
+            self._gather_into(out3, np.asarray(c[0]), i * chunk_steps,
+                              t_tok, start, p0)
+        out = out3.reshape(n * mb, t_tok)[:b]
+        if eos_id is not None:
+            # freeze everything after each sequence's first generated EOS
+            gen = out[:, plen:]
+            hit = gen == eos_id
+            first = np.where(hit.any(1), hit.argmax(1), gen.shape[1])
+            mask = np.arange(gen.shape[1])[None, :] > first[:, None]
+            gen[mask] = eos_id
+        return out

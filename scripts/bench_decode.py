@@ -1,0 +1,117 @@
+"""TPU benchmark for the pipelined KV-cache decoder (DECODE_r04.json).
+
+Measures greedy autoregressive generation throughput of the GPT-2-small
+geometry (12 layers, d=768, 50257 vocab) on the available chip(s):
+tokens/sec across a microbatch sweep, plus an approximate model-FLOPs
+utilisation from the per-token cost model
+
+    flops/token ~= L * (24 d^2 + 4 pos_avg d) + 2 d V
+
+(qkv+proj+mlp matmuls per layer, attention against the growing cache,
+lm_head).  The whole generation runs as ONE scan dispatch per
+``token_chunk`` tokens, so the tunnel's ~64 ms/sync (PROFILE_r04.md) is
+paid once per chunk, not per token.
+
+Prints one JSON dict on stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from defer_tpu.models import gpt
+    from defer_tpu.runtime.decode import PipelinedDecoder
+    from defer_tpu.utils.hw import identify_chip, peak_flops
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    out = {
+        "metric": "gpt_small_pipelined_decode",
+        "platform": devices[0].platform,
+        "device_kind": str(getattr(devices[0], "device_kind", "")),
+    }
+    if on_tpu:
+        layers, d, heads, vocab = 12, 768, 12, 50257
+        max_len, plen, new = 512, 32, 128
+        mbs = (8, 32, 64)
+        cd = jnp.bfloat16
+        gen = identify_chip(devices[0])
+        peak = peak_flops(gen)
+        out["tpu_generation"] = gen
+    else:  # CPU smoke
+        layers, d, heads, vocab = 4, 64, 2, 128
+        max_len, plen, new = 48, 8, 16
+        mbs = (4,)
+        cd = None
+        peak = 0.0
+
+    graph = gpt(layers, d, heads, max_len, vocab=vocab)
+    params = graph.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+
+    pos_avg = plen + new / 2
+    flops_tok = layers * (24 * d * d + 4 * pos_avg * d) + 2 * d * vocab
+    out["flops_per_token_model"] = flops_tok
+    out["config"] = {"layers": layers, "d_model": d, "vocab": vocab,
+                     "prompt_len": plen, "new_tokens": new,
+                     "max_len": max_len, "num_stages": 1}
+
+    # token_chunk keeps ONE compiled program across warmup and the timed
+    # run (the decode program cache is keyed by chunk length); the first
+    # call compiles, the timed second call is dispatch-only
+    token_chunk = 32
+    sweep = {}
+    for mb in mbs:
+        for use_prefill in ((False, True) if on_tpu else (False,)):
+            tag = f"mb{mb}" + ("_prefill" if use_prefill else "")
+            try:
+                dec = PipelinedDecoder(graph, params, num_stages=1,
+                                       microbatch=mb, max_len=max_len,
+                                       compute_dtype=cd)
+                prompt = rng.integers(0, vocab,
+                                      size=(mb, plen)).astype(np.int32)
+                kw = dict(max_new_tokens=new, token_chunk=token_chunk,
+                          prefill=use_prefill)
+                t0 = time.perf_counter()
+                dec.generate(prompt, **kw)          # compile + run
+                compile_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                toks = dec.generate(prompt, **kw)   # warm: dispatch only
+                dt = time.perf_counter() - t0
+                assert toks.shape == (mb, plen + new)
+                tps = mb * new / dt
+                row = {"tokens_per_s": round(tps, 2),
+                       "ms_per_token_step": round(1e3 * dt / new, 3),
+                       "wall_s": round(dt, 3),
+                       "first_call_s": round(compile_s, 3)}
+                if peak:
+                    row["mfu_decode"] = round(flops_tok * tps / peak, 5)
+                sweep[tag] = row
+                print(f"{tag}: {tps:.1f} tok/s "
+                      f"({1e3 * dt / new:.1f} ms/token-step, "
+                      f"first call {compile_s:.1f}s)",
+                      file=sys.stderr, flush=True)
+                del dec
+            except Exception as e:  # noqa: BLE001 — OOM at big mb is data
+                sweep[tag] = {"error": repr(e)[:200]}
+                print(f"{tag}: {e!r}", file=sys.stderr, flush=True)
+    out["decode_sweep"] = sweep
+    out["token_chunk"] = token_chunk
+    ok = [v["tokens_per_s"] for v in sweep.values() if "tokens_per_s" in v]
+    out["value"] = max(ok) if ok else 0.0
+    out["unit"] = "tokens/sec"
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

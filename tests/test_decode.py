@@ -28,8 +28,10 @@ def incremental_greedy(graph, params, prompt, t_tok, max_len):
     blocks = [nm for nm in graph.topo_order if nm.startswith("block_")]
     b, plen = prompt.shape
     d = nodes[blocks[0]].out_spec.shape[-1]
-    kc = {nm: jnp.zeros((b, max_len + 1, d)) for nm in blocks}
-    vc = {nm: jnp.zeros((b, max_len + 1, d)) for nm in blocks}
+    nh = nodes[blocks[0]].op.num_heads
+    shape = (b, nh, max_len + 1, d // nh)  # head-major cache contract
+    kc = {nm: jnp.zeros(shape) for nm in blocks}
+    vc = {nm: jnp.zeros(shape) for nm in blocks}
     out = np.zeros((b, t_tok), np.int64)
     out[:, :plen] = prompt
     for p in range(t_tok - 1):
@@ -106,6 +108,100 @@ def test_prompt_only_roundtrip(model, prompt):
     np.testing.assert_array_equal(out, prompt)
 
 
+def test_chunked_dispatch_matches_single_dispatch(model, prompt):
+    """token_chunk splits the scan into several dispatches with carried
+    state; results must be identical, and one compiled program must serve
+    different generation lengths."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=4, microbatch=2,
+                           max_len=MAX_LEN)
+    whole = dec.generate(prompt, max_new_tokens=9)
+    chunked = dec.generate(prompt, max_new_tokens=9, token_chunk=2)
+    np.testing.assert_array_equal(whole, chunked)
+    n_compiled = len(dec._decode_fns)
+    shorter = dec.generate(prompt, max_new_tokens=4, token_chunk=2)
+    assert len(dec._decode_fns) == n_compiled  # same program, shorter run
+    np.testing.assert_array_equal(shorter, whole[:, : 5 + 4])
+
+
+def test_sampling_deterministic_and_chunking_invariant(model, prompt):
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN)
+    a = dec.generate(prompt, max_new_tokens=8, temperature=1.0, seed=11)
+    b = dec.generate(prompt, max_new_tokens=8, temperature=1.0, seed=11)
+    np.testing.assert_array_equal(a, b)          # same seed -> same draw
+    c = dec.generate(prompt, max_new_tokens=8, temperature=1.0, seed=11,
+                     token_chunk=3)
+    np.testing.assert_array_equal(a, c)          # chunking-invariant
+    d = dec.generate(prompt, max_new_tokens=8, temperature=1.0, seed=12)
+    assert not np.array_equal(a, d)              # different seed differs
+    assert (a[:, 5:] < VOCAB).all() and (a[:, 5:] >= 0).all()
+    e = dec.generate(prompt, max_new_tokens=8, temperature=1.0, seed=11,
+                     top_k=5)
+    assert e.shape == a.shape
+
+
+def test_eos_early_stop(model, prompt):
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN)
+    ref = dec.generate(prompt, max_new_tokens=10)
+    # pick the token the greedy run emits first as the "EOS" so it triggers
+    eos = int(ref[0, 5])
+    got = dec.generate(prompt, max_new_tokens=10, eos_id=eos, token_chunk=2)
+    assert got.shape == ref.shape
+    for r in range(got.shape[0]):
+        gen = got[r, 5:]
+        hits = np.where(gen == eos)[0]
+        if hits.size:                      # everything after first EOS is EOS
+            assert (gen[hits[0]:] == eos).all()
+    # rows must agree with the unconstrained run up to their first EOS
+    row0 = ref[0, 5:]
+    stop = np.where(row0 == eos)[0][0]
+    np.testing.assert_array_equal(got[0, 5: 5 + stop + 1],
+                                  ref[0, 5: 5 + stop + 1])
+
+
+@pytest.mark.parametrize("num_stages,microbatch", [(4, 2), (1, 8)])
+def test_fused_prefill_matches_decode_rate(model, prompt, num_stages,
+                                           microbatch):
+    """prefill=True seeds the caches with the pipelined full-sequence pass;
+    greedy tokens must match the decode-rate teacher-forced path."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=num_stages,
+                           microbatch=microbatch, max_len=MAX_LEN)
+    slow = dec.generate(prompt, max_new_tokens=9)
+    fast = dec.generate(prompt, max_new_tokens=9, prefill=True)
+    np.testing.assert_array_equal(slow, fast)
+
+
+def test_prefill_single_new_token(model, prompt):
+    """max_new_tokens=1 with prefill needs zero decode steps."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN)
+    ref = dec.generate(prompt, max_new_tokens=1)
+    got = dec.generate(prompt, max_new_tokens=1, prefill=True)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_prefill_with_chunking_and_eos(model, prompt):
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN)
+    ref = dec.generate(prompt, max_new_tokens=8)
+    got = dec.generate(prompt, max_new_tokens=8, prefill=True,
+                       token_chunk=2)
+    np.testing.assert_array_equal(ref, got)
+    eos = int(ref[0, 6])
+    stopped = dec.generate(prompt, max_new_tokens=8, prefill=True,
+                           token_chunk=2, eos_id=eos)
+    gen = stopped[0, 5:]
+    hits = np.where(gen == eos)[0]
+    assert hits.size and (gen[hits[0]:] == eos).all()
+
+
 def test_repeat_generate_reuses_compiled_program(model, prompt):
     graph, params = model
     dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
@@ -154,8 +250,8 @@ def test_causal_block_full_vs_decode(model):
     x = jnp.asarray(rng.standard_normal((2, 6, 32)), jnp.float32)
     full = np.asarray(op.apply(p, x))
     d = x.shape[-1]
-    kc = jnp.zeros((2, 8, d))
-    vc = jnp.zeros((2, 8, d))
+    kc = jnp.zeros((2, op.num_heads, 8, d // op.num_heads))
+    vc = jnp.zeros((2, op.num_heads, 8, d // op.num_heads))
     for t in range(6):
         y, kc, vc = op.decode(p, x[:, t], kc, vc, t)
         np.testing.assert_allclose(np.asarray(y), full[:, t],
