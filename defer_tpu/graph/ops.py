@@ -426,27 +426,42 @@ class TransformerBlock(Op):
         att = jax.nn.softmax(att, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", att, v)
 
+    def _split_qkv(self, qkv):
+        """q/k/v column split of the fused projection (subclass hook)."""
+        return jnp.split(qkv, 3, axis=-1)
+
+    def _kv_head_count(self) -> int:
+        """KV head count (subclass hook; GQA blocks return fewer)."""
+        return self.num_heads
+
     def apply(self, params, x):
         return self.apply_with_kv(params, x)[0]
 
     def apply_with_kv(self, params, x):
-        """Forward that also returns the raw K/V projections [b, t, d].
+        """Forward that also returns the raw K/V projections.
 
         The single definition of the block forward — ``apply`` discards the
         byproducts (XLA dead-code-eliminates them); decode-cache seeding
-        (models/gpt.py prefill) consumes them.
+        (models/gpt.py prefill) consumes them.  K/V are [b, t, kv*hd]
+        pre-head-split columns (kv == num_heads unless a GQA subclass
+        narrows them).
         """
         p = _cast(params, x.dtype)
         b, t, d = x.shape
         nh = self.num_heads
         hd = d // nh
+        kvh = self._kv_head_count()
 
         y = self._ln(p["ln1"], x)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = self._split_qkv(qkv)
         qh = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        kh = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        vh = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        kh = k.reshape(b, t, kvh, hd).transpose(0, 2, 1, 3)
+        vh = v.reshape(b, t, kvh, hd).transpose(0, 2, 1, 3)
+        if kvh != nh:
+            # broadcast each KV head over its query group (exact GQA)
+            kh = jnp.repeat(kh, nh // kvh, axis=1)
+            vh = jnp.repeat(vh, nh // kvh, axis=1)
         y = self._attend(qh, kh, vh)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])

@@ -33,7 +33,69 @@ class CausalTransformerBlock(TransformerBlock):
     Full-sequence ``apply`` masks causally (flash kernel's bottom-right
     alignment, ops/flash_attention.py); ``decode`` is the incremental
     single-token step used by the pipelined decoder.
+
+    ``num_kv_heads`` enables grouped-query attention (MQA at 1): query
+    heads share ``num_heads // num_kv_heads``-way KV groups, shrinking the
+    decode KV cache — and its per-step HBM read, the decode bottleneck —
+    by that factor.  ``None`` keeps classic multi-head attention.
     """
+
+    num_kv_heads: int | None = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    def _check_kv(self):
+        if self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"num_kv_heads={self.kv_heads}")
+
+    def init(self, key, in_specs):
+        kv = self.kv_heads
+        if kv == self.num_heads:
+            return super().init(key, in_specs)
+        self._check_kv()
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        hd = d // self.num_heads
+        p = super().init(key, in_specs)
+        # narrow the fused qkv projection: d query cols + 2*kv*hd KV cols
+        w = p["qkv"]["w"]
+        p["qkv"] = {
+            "w": jnp.concatenate(
+                [w[:, :d], w[:, d: d + kv * hd],
+                 w[:, 2 * d: 2 * d + kv * hd]], axis=-1),
+            "b": jnp.zeros((d + 2 * kv * hd,), jnp.float32),
+        }
+        return p
+
+    def _split_qkv(self, qkv):
+        """Static q/k/v column split: d query cols, kv*hd each for K/V."""
+        nh, kv = self.num_heads, self.kv_heads
+        hd = qkv.shape[-1] // (nh + 2 * kv)
+        dq = nh * hd
+        return (qkv[..., :dq], qkv[..., dq: dq + kv * hd],
+                qkv[..., dq + kv * hd:])
+
+    def _kv_head_count(self) -> int:
+        return self.kv_heads
+
+    def flops(self, in_specs, out_spec):
+        # base formula assumes a 3d-wide qkv projection; GQA narrows it
+        (spec,) = in_specs
+        t, d = spec.shape
+        qkv_cols = d + 2 * self.kv_heads * (d // self.num_heads)
+        return (2 * t * d * (qkv_cols + d + 2 * self.mlp_ratio * d)
+                + 4 * t * t * d)
+
+    def tp_shard(self, params, tp, rank):
+        if self.kv_heads != self.num_heads:
+            raise NotImplementedError(
+                "GQA blocks do not support tensor parallelism yet (the "
+                "Megatron qkv column split assumes equal head groups)")
+        return super().tp_shard(params, tp, rank)
 
     def _attend(self, q, k, v):
         impl = self.attn_impl
@@ -64,38 +126,42 @@ class CausalTransformerBlock(TransformerBlock):
     def decode(self, params, x, k_cache, v_cache, pos):
         """One-token step: ``x`` [b, d] at position ``pos``.
 
-        ``k_cache``/``v_cache`` are **head-major** [b, nh, L, hd] with
-        L > max position — heads lead so the attention contractions are
+        ``k_cache``/``v_cache`` are **head-major** [b, kv, L, hd] with
+        L > max position — KV heads lead so the attention contractions are
         plain batched dots; a position-major [b, L, d] layout would make
-        XLA materialize a transpose of the whole cache every step.  The
-        new key/value row is written at ``pos`` (callers pass a clamped
-        scratch index for bubble steps) and attention covers positions
-        <= ``pos``.  Returns ``(y [b, d], k_cache, v_cache)``.
+        XLA materialize a transpose of the whole cache every step.  Under
+        GQA, kv < num_heads and each cache head serves its whole query
+        group without materializing repeats.  The new key/value row is
+        written at ``pos`` (callers pass a clamped scratch index for
+        bubble steps) and attention covers positions <= ``pos``.
+        Returns ``(y [b, d], k_cache, v_cache)``.
         """
         p = _cast(params, x.dtype)
         b, d = x.shape
         nh = self.num_heads
+        kv = self.kv_heads
+        grp = nh // kv
         hd = d // nh
         cache_len = k_cache.shape[2]
 
         y = self._ln(p["ln1"], x)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
-        q, k_new, v_new = jnp.split(qkv, 3, axis=-1)       # [b, d] each
+        q, k_new, v_new = self._split_qkv(qkv)
         k_cache = lax.dynamic_update_slice(
-            k_cache, k_new.reshape(b, nh, 1, hd).astype(k_cache.dtype),
+            k_cache, k_new.reshape(b, kv, 1, hd).astype(k_cache.dtype),
             (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(
-            v_cache, v_new.reshape(b, nh, 1, hd).astype(v_cache.dtype),
+            v_cache, v_new.reshape(b, kv, 1, hd).astype(v_cache.dtype),
             (0, 0, pos, 0))
 
-        qh = q.reshape(b, nh, hd)
+        qh = q.reshape(b, kv, grp, hd)
         kh = k_cache.astype(x.dtype)
         vh = v_cache.astype(x.dtype)
-        att = jnp.einsum("bhd,bhld->bhl", qh, kh) / math.sqrt(hd)
-        live = jnp.arange(cache_len)[None, None, :] <= pos
+        att = jnp.einsum("bkgd,bkld->bkgl", qh, kh) / math.sqrt(hd)
+        live = jnp.arange(cache_len)[None, None, None, :] <= pos
         att = jnp.where(live, att, jnp.asarray(-jnp.inf, att.dtype))
         att = jax.nn.softmax(att, axis=-1)
-        y = jnp.einsum("bhl,bhld->bhd", att, vh).reshape(b, d)
+        y = jnp.einsum("bkgl,bkld->bkgd", att, vh).reshape(b, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 
         y = self._ln(p["ln2"], x)
@@ -137,30 +203,35 @@ class GptEmbedding(Op):
 
 
 def gpt(num_layers: int, hidden: int, heads: int, seq_len: int,
-        vocab: int = 50257, name: str = "gpt") -> LayerGraph:
+        vocab: int = 50257, kv_heads: int | None = None,
+        name: str = "gpt") -> LayerGraph:
     """Causal LM graph: ids [t] -> logits [t, vocab].
 
     ``block_k`` nodes are the pipeline cut points; the decode engine
     (:mod:`defer_tpu.runtime.decode`) consumes the same graph by node-name
     contract: ``embeddings``, ``block_0..``, ``final_ln``, ``lm_head``.
+    ``kv_heads`` < ``heads`` builds a GQA model (MQA at 1).
     """
     b = GraphBuilder(name)
     x = b.input((seq_len,), jnp.int32)
     x = b.add(GptEmbedding(vocab, hidden, seq_len), x, name="embeddings")
     for i in range(num_layers):
-        x = b.add(CausalTransformerBlock(heads), x, name=f"block_{i}")
+        x = b.add(CausalTransformerBlock(heads, num_kv_heads=kv_heads),
+                  x, name=f"block_{i}")
     x = b.add(LayerNorm(), x, name="final_ln")
     x = b.add(Dense(vocab), x, name="lm_head")
     return b.build()
 
 
-def gpt_small(seq_len: int = 256) -> LayerGraph:
+def gpt_small(seq_len: int = 256, kv_heads: int | None = None) -> LayerGraph:
     """GPT-2 small geometry (12 layers, d=768, 12 heads)."""
-    return gpt(12, 768, 12, seq_len, name="gpt_small")
+    return gpt(12, 768, 12, seq_len, kv_heads=kv_heads, name="gpt_small")
 
 
-def gpt_tiny(seq_len: int = 16, vocab: int = 97) -> LayerGraph:
-    return gpt(4, 32, 2, seq_len, vocab=vocab, name="gpt_tiny")
+def gpt_tiny(seq_len: int = 16, vocab: int = 97,
+             kv_heads: int | None = None) -> LayerGraph:
+    return gpt(4, 32, 2, seq_len, vocab=vocab, kv_heads=kv_heads,
+               name="gpt_tiny")
 
 
 def gpt_stage_cuts(num_layers: int, num_stages: int) -> list[str]:

@@ -140,8 +140,18 @@ class PipelinedDecoder:
                 raise TypeError(f"{nm} is not a CausalTransformerBlock")
         self.d_model = nodes[block_names[0]].out_spec.shape[-1]
         self.num_heads = nodes[block_names[0]].op.num_heads
+        self.num_kv_heads = nodes[block_names[0]].op.kv_heads
         self.head_dim = self.d_model // self.num_heads
         self.vocab = nodes["lm_head"].out_spec.shape[-1]
+        for nm in block_names:
+            op = nodes[nm].op
+            if (op.num_heads, op.kv_heads) != (self.num_heads,
+                                               self.num_kv_heads):
+                raise ValueError(
+                    f"{nm} has heads ({op.num_heads}, kv {op.kv_heads}) "
+                    f"!= block_0's ({self.num_heads}, "
+                    f"{self.num_kv_heads}); the homogeneous cache needs "
+                    "one head geometry")
 
         assign = _split_blocks(len(block_names), n)
         self.stage_blocks = [[block_names[i] for i in idxs]
@@ -179,8 +189,9 @@ class PipelinedDecoder:
         # group axis is n+1: slot n is the scratch group that pipelined
         # prefill's warmup/drain bubbles write into (the group-axis twin of
         # the max_len scratch row).  Head-major position axis per the
-        # CausalTransformerBlock.decode cache contract.
-        self._cache_shape = (self.l_max, n + 1, mb, self.num_heads,
+        # CausalTransformerBlock.decode cache contract; under GQA the head
+        # axis is the (smaller) KV head count.
+        self._cache_shape = (self.l_max, n + 1, mb, self.num_kv_heads,
                              max_len + 1, self.head_dim)
         #: compiled decode programs keyed by (chunk_steps, sample, top_k) —
         #: repeat ``generate`` calls of a matching shape are dispatch-only
@@ -302,12 +313,12 @@ class PipelinedDecoder:
             else:
                 x = a.reshape(mb, plen, d).astype(cd)
 
-            nh, hd = self.num_heads, self.head_dim
+            kvh, hd = self.num_kv_heads, self.head_dim
             for l, nm in enumerate(self.stage_blocks[s]):
                 x, k, v = nodes[nm].op.apply_with_kv(p[nm], x)
                 # head-major relayout (one transpose per prompt, amortized)
-                k = k.reshape(mb, plen, nh, hd).transpose(0, 2, 1, 3)
-                v = v.reshape(mb, plen, nh, hd).transpose(0, 2, 1, 3)
+                k = k.reshape(mb, plen, kvh, hd).transpose(0, 2, 1, 3)
+                v = v.reshape(mb, plen, kvh, hd).transpose(0, 2, 1, 3)
                 kc = lax.dynamic_update_slice(
                     kc, k[None, None].astype(kc.dtype),
                     (l, write_g, 0, 0, 0, 0))
@@ -472,14 +483,6 @@ class PipelinedDecoder:
             if p0 <= p < t_tok:
                 out[g, :, p] = ids_steps[i].astype(np.int64)
 
-    def _gather(self, ids_steps: np.ndarray, prompt: np.ndarray,
-                plen: int, t_tok: int, start: int = 0,
-                first_ids: np.ndarray | None = None) -> np.ndarray:
-        """Map emitted wrap-link ids back to (group, position) order."""
-        out, p0 = self._gather_init(prompt, plen, t_tok, start, first_ids)
-        self._gather_into(out, ids_steps, 0, t_tok, start, p0)
-        return out
-
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int | None = None,
                  seed: int = 0, eos_id: int | None = None,
@@ -487,9 +490,10 @@ class PipelinedDecoder:
                  prefill: bool = False) -> np.ndarray:
         """Decode ``max_new_tokens`` past each prompt.
 
-        ``prompt_ids``: [B, prompt_len] ints, B <= num_stages * microbatch
-        and B % microbatch == 0.  All prompts share one length (pad/bucket
-        upstream).  Returns [B, prompt_len + max_new_tokens].
+        ``prompt_ids``: [B, prompt_len] ints, B % microbatch == 0; batches
+        beyond one pipeline fill (num_stages * microbatch) are processed
+        in successive full-pipe rounds.  All prompts share one length
+        (pad/bucket upstream).  Returns [B, prompt_len + max_new_tokens].
 
         ``temperature=0`` is greedy argmax; ``temperature>0`` samples the
         softmax (optionally truncated to ``top_k``), keyed by
@@ -515,10 +519,21 @@ class PipelinedDecoder:
             raise ValueError("prompt must contain at least one token "
                              "(position 0 has nothing to condition on)")
         n, mb = self.num_stages, self.microbatch
-        if b % mb or not 0 < b <= n * mb:
+        if b % mb or b == 0:
             raise ValueError(
-                f"B={b} must be a multiple of microbatch={mb} and at most "
-                f"num_stages*microbatch={n * mb}")
+                f"B={b} must be a non-zero multiple of microbatch={mb}")
+        if b > n * mb:
+            # more sequences than one pipeline fill: successive rounds.
+            # Each round derives its own seed — otherwise identical
+            # prompts in different rounds would sample identical
+            # continuations (the step keys restart at t=0 every round).
+            return np.concatenate(
+                [self.generate(prompt_ids[lo: lo + n * mb],
+                               max_new_tokens, temperature=temperature,
+                               top_k=top_k, seed=seed + lo,
+                               eos_id=eos_id, token_chunk=token_chunk,
+                               prefill=prefill)
+                 for lo in range(0, b, n * mb)], axis=0)
         t_tok = plen + max_new_tokens
         if t_tok > self.max_len:
             raise ValueError(
