@@ -57,6 +57,9 @@ def main():
 
     graph = gpt(layers, d, heads, max_len, vocab=vocab)
     params = graph.init(jax.random.key(0))
+    gqa_kv = max(1, heads // 6)  # GQA variant: 6-way query groups
+    graph_gqa = gpt(layers, d, heads, max_len, vocab=vocab, kv_heads=gqa_kv)
+    params_gqa = graph_gqa.init(jax.random.key(0))
     rng = np.random.default_rng(0)
 
     pos_avg = plen + new / 2
@@ -71,40 +74,44 @@ def main():
     # call compiles, the timed second call is dispatch-only
     token_chunk = 32
     sweep = {}
+    variants = [("", graph, params)]
+    if on_tpu:
+        variants.append((f"_gqa{gqa_kv}kv", graph_gqa, params_gqa))
     for mb in mbs:
-        for use_prefill in ((False, True) if on_tpu else (False,)):
-            tag = f"mb{mb}" + ("_prefill" if use_prefill else "")
-            try:
-                dec = PipelinedDecoder(graph, params, num_stages=1,
-                                       microbatch=mb, max_len=max_len,
-                                       compute_dtype=cd)
-                prompt = rng.integers(0, vocab,
-                                      size=(mb, plen)).astype(np.int32)
-                kw = dict(max_new_tokens=new, token_chunk=token_chunk,
-                          prefill=use_prefill)
-                t0 = time.perf_counter()
-                dec.generate(prompt, **kw)          # compile + run
-                compile_s = time.perf_counter() - t0
-                t0 = time.perf_counter()
-                toks = dec.generate(prompt, **kw)   # warm: dispatch only
-                dt = time.perf_counter() - t0
-                assert toks.shape == (mb, plen + new)
-                tps = mb * new / dt
-                row = {"tokens_per_s": round(tps, 2),
-                       "ms_per_token_step": round(1e3 * dt / new, 3),
-                       "wall_s": round(dt, 3),
-                       "first_call_s": round(compile_s, 3)}
-                if peak:
-                    row["mfu_decode"] = round(flops_tok * tps / peak, 5)
-                sweep[tag] = row
-                print(f"{tag}: {tps:.1f} tok/s "
-                      f"({1e3 * dt / new:.1f} ms/token-step, "
-                      f"first call {compile_s:.1f}s)",
-                      file=sys.stderr, flush=True)
-                del dec
-            except Exception as e:  # noqa: BLE001 — OOM at big mb is data
-                sweep[tag] = {"error": repr(e)[:200]}
-                print(f"{tag}: {e!r}", file=sys.stderr, flush=True)
+        for vtag, vgraph, vparams in variants:
+            for use_prefill in ((False, True) if on_tpu else (False,)):
+                tag = f"mb{mb}{vtag}" + ("_prefill" if use_prefill else "")
+                try:
+                    dec = PipelinedDecoder(vgraph, vparams, num_stages=1,
+                                           microbatch=mb, max_len=max_len,
+                                           compute_dtype=cd)
+                    prompt = rng.integers(0, vocab,
+                                          size=(mb, plen)).astype(np.int32)
+                    kw = dict(max_new_tokens=new, token_chunk=token_chunk,
+                              prefill=use_prefill)
+                    t0 = time.perf_counter()
+                    dec.generate(prompt, **kw)          # compile + run
+                    compile_s = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    toks = dec.generate(prompt, **kw)   # warm
+                    dt = time.perf_counter() - t0
+                    assert toks.shape == (mb, plen + new)
+                    tps = mb * new / dt
+                    row = {"tokens_per_s": round(tps, 2),
+                           "ms_per_token_step": round(1e3 * dt / new, 3),
+                           "wall_s": round(dt, 3),
+                           "first_call_s": round(compile_s, 3)}
+                    if peak:
+                        row["mfu_decode"] = round(flops_tok * tps / peak, 5)
+                    sweep[tag] = row
+                    print(f"{tag}: {tps:.1f} tok/s "
+                          f"({1e3 * dt / new:.1f} ms/token-step, "
+                          f"first call {compile_s:.1f}s)",
+                          file=sys.stderr, flush=True)
+                    del dec
+                except Exception as e:  # noqa: BLE001 — OOM data point
+                    sweep[tag] = {"error": repr(e)[:200]}
+                    print(f"{tag}: {e!r}", file=sys.stderr, flush=True)
     out["decode_sweep"] = sweep
     out["token_chunk"] = token_chunk
     ok = [v["tokens_per_s"] for v in sweep.values() if "tokens_per_s" in v]
