@@ -123,7 +123,22 @@ class CausalTransformerBlock(TransformerBlock):
     # projections), so pipelined prefill bulk-writes cache rows 0..t-1
     # (after the head-major relayout) and decoding continues at t.
 
-    def decode(self, params, x, k_cache, v_cache, pos):
+    @staticmethod
+    def quantize_row(row):
+        """Symmetric per-(head, position)-row int8: [..., hd] float ->
+        ([..., hd] int8, [...] f32 scale).  One scale per cache row keeps
+        dequantization a scalar multiply that folds EXACTLY into the
+        attention contractions (the scale is constant over the contracted
+        head dim), so the int8 cache is read raw by the dots and no
+        dequantized copy is ever materialized."""
+        rowf = row.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(rowf), axis=-1)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(rowf / scale[..., None]), -127, 127)
+        return q.astype(jnp.int8), scale
+
+    def decode(self, params, x, k_cache, v_cache, pos,
+               k_scale=None, v_scale=None):
         """One-token step: ``x`` [b, d] at position ``pos``.
 
         ``k_cache``/``v_cache`` are **head-major** [b, kv, L, hd] with
@@ -134,7 +149,12 @@ class CausalTransformerBlock(TransformerBlock):
         group without materializing repeats.  The new key/value row is
         written at ``pos`` (callers pass a clamped scratch index for
         bubble steps) and attention covers positions <= ``pos``.
-        Returns ``(y [b, d], k_cache, v_cache)``.
+
+        With ``k_scale``/``v_scale`` ([b, kv, L] f32) the caches are int8
+        rows quantized by :meth:`quantize_row`; scales fold into the dots
+        exactly (per-row constants), so ICI^W HBM reads shrink to ~1
+        byte/value.  Returns ``(y, k_cache, v_cache)`` plus the updated
+        scales when quantized.
         """
         p = _cast(params, x.dtype)
         b, d = x.shape
@@ -143,30 +163,43 @@ class CausalTransformerBlock(TransformerBlock):
         grp = nh // kv
         hd = d // nh
         cache_len = k_cache.shape[2]
+        quant = k_scale is not None
 
         y = self._ln(p["ln1"], x)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
         q, k_new, v_new = self._split_qkv(qkv)
+        k_row = k_new.reshape(b, kv, 1, hd)
+        v_row = v_new.reshape(b, kv, 1, hd)
+        if quant:
+            k_row, ks_row = self.quantize_row(k_row)
+            v_row, vs_row = self.quantize_row(v_row)
+            k_scale = lax.dynamic_update_slice(k_scale, ks_row, (0, 0, pos))
+            v_scale = lax.dynamic_update_slice(v_scale, vs_row, (0, 0, pos))
         k_cache = lax.dynamic_update_slice(
-            k_cache, k_new.reshape(b, kv, 1, hd).astype(k_cache.dtype),
-            (0, 0, pos, 0))
+            k_cache, k_row.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = lax.dynamic_update_slice(
-            v_cache, v_new.reshape(b, kv, 1, hd).astype(v_cache.dtype),
-            (0, 0, pos, 0))
+            v_cache, v_row.astype(v_cache.dtype), (0, 0, pos, 0))
 
         qh = q.reshape(b, kv, grp, hd)
         kh = k_cache.astype(x.dtype)
         vh = v_cache.astype(x.dtype)
         att = jnp.einsum("bkgd,bkld->bkgl", qh, kh) / math.sqrt(hd)
+        if quant:
+            att = att * k_scale[:, :, None, :].astype(att.dtype)
         live = jnp.arange(cache_len)[None, None, None, :] <= pos
         att = jnp.where(live, att, jnp.asarray(-jnp.inf, att.dtype))
         att = jax.nn.softmax(att, axis=-1)
+        if quant:
+            att = att * v_scale[:, :, None, :].astype(att.dtype)
         y = jnp.einsum("bkgl,bkld->bkgd", att, vh).reshape(b, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 
         y = self._ln(p["ln2"], x)
         y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
-        return x + (y @ p["fc2"]["w"] + p["fc2"]["b"]), k_cache, v_cache
+        out = x + (y @ p["fc2"]["w"] + p["fc2"]["b"])
+        if quant:
+            return out, k_cache, v_cache, k_scale, v_scale
+        return out, k_cache, v_cache
 
 
 class GptEmbedding(Op):

@@ -109,6 +109,7 @@ class PipelinedDecoder:
         mesh: Mesh | None = None,
         microbatch: int = 1,
         compute_dtype=None,
+        kv_cache: str = "buffer",
     ):
         self.graph = graph
         self.num_stages = n = num_stages
@@ -120,6 +121,10 @@ class PipelinedDecoder:
         self.max_len = max_len
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype \
             else jnp.dtype(jnp.float32)
+        if kv_cache not in ("buffer", "int8"):
+            raise ValueError(
+                f"kv_cache must be 'buffer' or 'int8', got {kv_cache!r}")
+        self.kv_cache = kv_cache
 
         nodes = graph.nodes
         for req in ("embeddings", "final_ln", "lm_head"):
@@ -193,6 +198,9 @@ class PipelinedDecoder:
         # axis is the (smaller) KV head count.
         self._cache_shape = (self.l_max, n + 1, mb, self.num_kv_heads,
                              max_len + 1, self.head_dim)
+        #: per-row f32 scales for the int8 cache (one per head x position)
+        self._scale_shape = (self.l_max, n + 1, mb, self.num_kv_heads,
+                             max_len + 1)
         #: compiled decode programs keyed by (chunk_steps, sample, top_k) —
         #: repeat ``generate`` calls of a matching shape are dispatch-only
         self._decode_fns: dict[tuple, Any] = {}
@@ -206,12 +214,22 @@ class PipelinedDecoder:
         return flatbuf.unpack_leaves(w_local, self._wmeta[s],
                                      self._wtreedef[s])
 
+    def _slice_lg(self, arr, l, g):
+        """[Lmax, N+1, ...] cache entry -> the (block l, group g) item."""
+        return lax.dynamic_slice(
+            arr, (l, g) + (0,) * (arr.ndim - 2),
+            (1, 1) + arr.shape[2:])[0, 0]
+
+    def _write_lg(self, arr, item, l, g):
+        return lax.dynamic_update_slice(
+            arr, item[None, None], (l, g) + (0,) * (arr.ndim - 2))
+
     def _make_branch(self, s: int, sample: bool, top_k: int | None):
         """Stage ``s``'s step: consume the ring buffer, update caches.
 
         Uniform signature for ``lax.switch``:
-        ``(w_local, a, kc, vc, prompt, g, pos, plen, t, seed, temp)
-        -> (a_out, kc, vc)``.
+        ``(w_local, a, caches, prompt, g, pos, plen, t, seed, temp,
+        first_ids, first_pos) -> (a_out, caches)``.
         """
         n = self.num_stages
         nodes = self.graph.nodes
@@ -219,8 +237,9 @@ class PipelinedDecoder:
         is_first, is_last = s == 0, s == n - 1
         block_ops = [nodes[nm].op for nm in self.stage_blocks[s]]
         embed_op = self.embed_op
+        int8 = self.kv_cache == "int8"
 
-        def branch(w_local, a, kc, vc, prompt, g, pos, plen, t, seed, temp,
+        def branch(w_local, a, caches, prompt, g, pos, plen, t, seed, temp,
                    first_ids, first_pos):
             p = self._stage_params(s, w_local)
             # bubble steps (pos < 0 during warmup skew, or pos >= max_len
@@ -249,17 +268,22 @@ class PipelinedDecoder:
 
             for l, (nm, op) in enumerate(zip(self.stage_blocks[s],
                                              block_ops)):
-                k_l = lax.dynamic_slice(
-                    kc, (l, g, 0, 0, 0, 0),
-                    (1, 1) + self._cache_shape[2:])[0, 0]
-                v_l = lax.dynamic_slice(
-                    vc, (l, g, 0, 0, 0, 0),
-                    (1, 1) + self._cache_shape[2:])[0, 0]
-                x, k_l, v_l = op.decode(p[nm], x, k_l, v_l, write_pos)
-                kc = lax.dynamic_update_slice(
-                    kc, k_l[None, None], (l, g, 0, 0, 0, 0))
-                vc = lax.dynamic_update_slice(
-                    vc, v_l[None, None], (l, g, 0, 0, 0, 0))
+                k_l = self._slice_lg(caches["k"], l, g)
+                v_l = self._slice_lg(caches["v"], l, g)
+                if int8:
+                    ks_l = self._slice_lg(caches["ks"], l, g)
+                    vs_l = self._slice_lg(caches["vs"], l, g)
+                    x, k_l, v_l, ks_l, vs_l = op.decode(
+                        p[nm], x, k_l, v_l, write_pos, ks_l, vs_l)
+                    caches = dict(
+                        caches,
+                        ks=self._write_lg(caches["ks"], ks_l, l, g),
+                        vs=self._write_lg(caches["vs"], vs_l, l, g))
+                else:
+                    x, k_l, v_l = op.decode(p[nm], x, k_l, v_l, write_pos)
+                caches = dict(caches,
+                              k=self._write_lg(caches["k"], k_l, l, g),
+                              v=self._write_lg(caches["v"], v_l, l, g))
 
             if is_last:
                 h = nodes["final_ln"].op.apply(p["final_ln"], x)
@@ -278,7 +302,7 @@ class PipelinedDecoder:
                 a_out = a_out.at[:, 0].set(ids.astype(jnp.float32))
             else:
                 a_out = x.astype(jnp.float32)
-            return a_out, kc, vc
+            return a_out, caches
 
         return branch
 
@@ -299,8 +323,9 @@ class PipelinedDecoder:
         mb, d = self.microbatch, self.d_model
         is_first, is_last = s == 0, s == n - 1
         embed_op = self.embed_op
+        int8 = self.kv_cache == "int8"
 
-        def branch(w_local, a, kc, vc, prompt, g, seed, temp):
+        def branch(w_local, a, caches, prompt, g, seed, temp):
             p = self._stage_params(s, w_local)
             valid = jnp.logical_and(g >= 0, g < n)
             safe_g = jnp.clip(g, 0, n - 1)
@@ -315,16 +340,30 @@ class PipelinedDecoder:
 
             kvh, hd = self.num_kv_heads, self.head_dim
             for l, nm in enumerate(self.stage_blocks[s]):
-                x, k, v = nodes[nm].op.apply_with_kv(p[nm], x)
+                op = nodes[nm].op
+                x, k, v = op.apply_with_kv(p[nm], x)
                 # head-major relayout (one transpose per prompt, amortized)
                 k = k.reshape(mb, plen, kvh, hd).transpose(0, 2, 1, 3)
                 v = v.reshape(mb, plen, kvh, hd).transpose(0, 2, 1, 3)
-                kc = lax.dynamic_update_slice(
-                    kc, k[None, None].astype(kc.dtype),
-                    (l, write_g, 0, 0, 0, 0))
-                vc = lax.dynamic_update_slice(
-                    vc, v[None, None].astype(vc.dtype),
-                    (l, write_g, 0, 0, 0, 0))
+                if int8:
+                    k, ks = op.quantize_row(k)   # [mb, kv, plen] scales
+                    v, vs = op.quantize_row(v)
+                    caches = dict(
+                        caches,
+                        ks=lax.dynamic_update_slice(
+                            caches["ks"], ks[None, None],
+                            (l, write_g, 0, 0, 0)),
+                        vs=lax.dynamic_update_slice(
+                            caches["vs"], vs[None, None],
+                            (l, write_g, 0, 0, 0)))
+                caches = dict(
+                    caches,
+                    k=lax.dynamic_update_slice(
+                        caches["k"], k[None, None].astype(
+                            caches["k"].dtype), (l, write_g, 0, 0, 0, 0)),
+                    v=lax.dynamic_update_slice(
+                        caches["v"], v[None, None].astype(
+                            caches["v"].dtype), (l, write_g, 0, 0, 0, 0)))
 
             if is_last:
                 h = nodes["final_ln"].op.apply(p["final_ln"], x[:, -1])
@@ -342,9 +381,17 @@ class PipelinedDecoder:
                 a_out = a_out.at[:, 0].set(ids.astype(jnp.float32))
             else:
                 a_out = x.reshape(mb, plen * d).astype(jnp.float32)
-            return a_out, kc, vc
+            return a_out, caches
 
         return branch
+
+    def _state_specs(self):
+        """shard_map spec pytree for the cache-state dict."""
+        spec7 = P(STAGE_AXIS, None, None, None, None, None, None)
+        if self.kv_cache == "int8":
+            spec6 = P(STAGE_AXIS, None, None, None, None, None)
+            return {"k": spec7, "v": spec7, "ks": spec6, "vs": spec6}
+        return {"k": spec7, "v": spec7}
 
     def _build_prefill_fn(self, plen: int, sample: bool, top_k: int | None):
         n = self.num_stages
@@ -354,33 +401,33 @@ class PipelinedDecoder:
         mb, d = self.microbatch, self.d_model
         num_steps = 2 * n - 1  # n groups through n stages, pipelined
 
-        def device_prefill(w, prompt, seed, temp, kc, vc):
+        def device_prefill(w, prompt, seed, temp, caches):
             w_l = w[0]
             idx = lax.axis_index(STAGE_AXIS)
             a0 = jnp.zeros((mb, plen * d), jnp.float32)
+            local = jax.tree.map(lambda c: c[0], caches)
 
             def body(carry, t):
-                a, kc, vc = carry
+                a, caches = carry
                 g = t - idx  # stage idx prefills group t - idx
-                a_out, kc, vc = lax.switch(
-                    idx, branches, w_l, a, kc, vc, prompt, g, seed, temp)
+                a_out, caches = lax.switch(
+                    idx, branches, w_l, a, caches, prompt, g, seed, temp)
                 a_next = lax.ppermute(a_out, STAGE_AXIS, perm)
-                return (a_next, kc, vc), a_next[:, 0]
+                return (a_next, caches), a_next[:, 0]
 
-            (_, kc, vc), ids = lax.scan(
-                body, (a0, kc[0], vc[0]),
-                jnp.arange(num_steps, dtype=jnp.int32))
-            return kc[None], vc[None], ids[None]
+            (_, local), ids = lax.scan(
+                body, (a0, local), jnp.arange(num_steps, dtype=jnp.int32))
+            return jax.tree.map(lambda c: c[None], local), ids[None]
 
-        state = P(STAGE_AXIS, None, None, None, None, None, None)
+        state = self._state_specs()
         fn = jax.shard_map(
             device_prefill, mesh=self.mesh,
             in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
-                      state, state),
-            out_specs=(state, state, P(STAGE_AXIS, None, None)),
+                      state),
+            out_specs=(state, P(STAGE_AXIS, None, None)),
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(4, 5))
+        return jax.jit(fn, donate_argnums=(4,))
 
     def _init_state(self):
         """Fresh sharded pipeline state: ring carry + empty KV caches.
@@ -390,18 +437,25 @@ class PipelinedDecoder:
         """
         if self._init_fn is None:
             n, mb, d = self.num_stages, self.microbatch, self.d_model
-            cd = self.compute_dtype
             act_sh = NamedSharding(self.mesh, P(STAGE_AXIS, None, None))
-            cache_sh = NamedSharding(
-                self.mesh, P(STAGE_AXIS, None, None, None, None, None))
+            state_sh = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._state_specs())
+            cdt = jnp.int8 if self.kv_cache == "int8" \
+                else self.compute_dtype
 
             def zeros():
-                return (jnp.zeros((n, mb, d), jnp.float32),
-                        jnp.zeros((n,) + self._cache_shape, cd),
-                        jnp.zeros((n,) + self._cache_shape, cd))
+                caches = {"k": jnp.zeros((n,) + self._cache_shape, cdt),
+                          "v": jnp.zeros((n,) + self._cache_shape, cdt)}
+                if self.kv_cache == "int8":
+                    caches["ks"] = jnp.zeros((n,) + self._scale_shape,
+                                             jnp.float32)
+                    caches["vs"] = jnp.zeros((n,) + self._scale_shape,
+                                             jnp.float32)
+                return jnp.zeros((n, mb, d), jnp.float32), caches
 
             self._init_fn = jax.jit(
-                zeros, out_shardings=(act_sh, cache_sh, cache_sh))
+                zeros, out_shardings=(act_sh, state_sh))
         return self._init_fn()
 
     def _build_decode_fn(self, chunk_steps: int, sample: bool,
@@ -411,43 +465,45 @@ class PipelinedDecoder:
         branches = [self._make_branch(s, sample, top_k) for s in range(n)]
 
         def device_decode(w, prompt, plen, t0, seed, temp, first_ids,
-                          first_pos, start, a, kc, vc):
+                          first_pos, start, a, caches):
             w_l = w[0]
             idx = lax.axis_index(STAGE_AXIS)
+            local = jax.tree.map(lambda c: c[0], caches)
 
             def body(carry, t):
-                a, kc, vc = carry
+                a, caches = carry
                 # stage idx serves group (t - idx) mod n at token position
                 # start + (t - idx)//n; negative skew = warmup bubble
                 rel = t - idx
                 g = jnp.where(rel >= 0, rel % n, 0)
                 pos = jnp.where(rel >= 0, start + rel // n, -1)
-                a_out, kc, vc = lax.switch(
-                    idx, branches, w_l, a, kc, vc, prompt, g, pos, plen,
+                a_out, caches = lax.switch(
+                    idx, branches, w_l, a, caches, prompt, g, pos, plen,
                     t, seed, temp, first_ids, first_pos)
                 a_next = lax.ppermute(a_out, STAGE_AXIS, perm)
                 # emit what just arrived on the wrap link: ids sampled by
                 # the last stage, readable on device 0 (runtime/spmd.py
                 # emits the same slice for the inference pipeline)
-                return (a_next, kc, vc), a_next[:, 0]
+                return (a_next, caches), a_next[:, 0]
 
-            (a, kc, vc), ids = lax.scan(
-                body, (a[0], kc[0], vc[0]),
+            (a, local), ids = lax.scan(
+                body, (a[0], local),
                 t0 + jnp.arange(chunk_steps, dtype=jnp.int32))
-            return a[None], kc[None], vc[None], ids[None]
+            return (a[None], jax.tree.map(lambda c: c[None], local),
+                    ids[None])
 
-        state = P(STAGE_AXIS, None, None, None, None, None, None)
+        state = self._state_specs()
         fn = jax.shard_map(
             device_decode, mesh=self.mesh,
             in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
                       P(), P(), P(None, None), P(), P(),
-                      P(STAGE_AXIS, None, None), state, state),
-            out_specs=(P(STAGE_AXIS, None, None), state, state,
+                      P(STAGE_AXIS, None, None), state),
+            out_specs=(P(STAGE_AXIS, None, None), state,
                        P(STAGE_AXIS, None, None)),
             check_vma=False,
         )
         # donate the carried state so chunked dispatches update in place
-        return jax.jit(fn, donate_argnums=(9, 10, 11))
+        return jax.jit(fn, donate_argnums=(9, 10))
 
     # ------------------------------------------------------------------
 
@@ -552,7 +608,7 @@ class PipelinedDecoder:
         plen_s = jnp.int32(plen)
         seed_s = jnp.uint32(seed)
         temp_s = jnp.float32(temperature)
-        a, kc, vc = self._init_state()
+        a, caches = self._init_state()
 
         if prefill:
             pkey = (plen, sample, top_k)
@@ -560,8 +616,8 @@ class PipelinedDecoder:
             if pfn is None:
                 pfn = self._prefill_fns[pkey] = \
                     self._build_prefill_fn(plen, sample, top_k)
-            kc, vc, pre_ids = pfn(self._w, prompt_dev, seed_s, temp_s,
-                                  kc, vc)
+            caches, pre_ids = pfn(self._w, prompt_dev, seed_s, temp_s,
+                                  caches)
             # group g's first generated token exits the wrap link at
             # prefill step g + (n-1)
             pre_np = np.asarray(pre_ids[0])
@@ -594,9 +650,9 @@ class PipelinedDecoder:
                                      first_ids_np)
         steps_run = 0
         while steps_run < num_steps:
-            a, kc, vc, ids = fn(self._w, prompt_dev, plen_s,
+            a, caches, ids = fn(self._w, prompt_dev, plen_s,
                                 jnp.int32(steps_run), seed_s, temp_s,
-                                fi_dev, fp_s, start_s, a, kc, vc)
+                                fi_dev, fp_s, start_s, a, caches)
             if eos_id is not None:
                 # incremental scatter of just this chunk: linear host work
                 self._gather_into(out3, np.asarray(ids[0]), steps_run,

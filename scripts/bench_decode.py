@@ -63,8 +63,17 @@ def main():
     rng = np.random.default_rng(0)
 
     pos_avg = plen + new / 2
-    flops_tok = layers * (24 * d * d + 4 * pos_avg * d) + 2 * d * vocab
+
+    def per_token_flops(kv):
+        # per layer: qkv (d + 2*kv*hd cols) + proj (d) + mlp (8d) matmuls
+        # at 2*d each, plus attention against the pos_avg-deep cache
+        qkv_cols = d + 2 * kv * (d // heads)
+        return (layers * (2 * d * (qkv_cols + d + 8 * d)
+                          + 4 * pos_avg * d) + 2 * d * vocab)
+
+    flops_tok = per_token_flops(heads)
     out["flops_per_token_model"] = flops_tok
+    out["flops_per_token_gqa"] = per_token_flops(gqa_kv)
     out["config"] = {"layers": layers, "d_model": d, "vocab": vocab,
                      "prompt_len": plen, "new_tokens": new,
                      "max_len": max_len, "num_stages": 1}
@@ -74,17 +83,20 @@ def main():
     # call compiles, the timed second call is dispatch-only
     token_chunk = 32
     sweep = {}
-    variants = [("", graph, params)]
+    variants = [("", graph, params, "buffer")]
     if on_tpu:
-        variants.append((f"_gqa{gqa_kv}kv", graph_gqa, params_gqa))
+        variants.append((f"_gqa{gqa_kv}kv", graph_gqa, params_gqa,
+                         "buffer"))
+        variants.append(("_int8kv", graph, params, "int8"))
     for mb in mbs:
-        for vtag, vgraph, vparams in variants:
+        for vtag, vgraph, vparams, vcache in variants:
             for use_prefill in ((False, True) if on_tpu else (False,)):
                 tag = f"mb{mb}{vtag}" + ("_prefill" if use_prefill else "")
                 try:
                     dec = PipelinedDecoder(vgraph, vparams, num_stages=1,
                                            microbatch=mb, max_len=max_len,
-                                           compute_dtype=cd)
+                                           compute_dtype=cd,
+                                           kv_cache=vcache)
                     prompt = rng.integers(0, vocab,
                                           size=(mb, plen)).astype(np.int32)
                     kw = dict(max_new_tokens=new, token_chunk=token_chunk,
@@ -102,7 +114,9 @@ def main():
                            "wall_s": round(dt, 3),
                            "first_call_s": round(compile_s, 3)}
                     if peak:
-                        row["mfu_decode"] = round(flops_tok * tps / peak, 5)
+                        ft = per_token_flops(
+                            gqa_kv if "gqa" in vtag else heads)
+                        row["mfu_decode"] = round(ft * tps / peak, 5)
                     sweep[tag] = row
                     print(f"{tag}: {tps:.1f} tok/s "
                           f"({1e3 * dt / new:.1f} ms/token-step, "

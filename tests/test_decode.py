@@ -242,6 +242,40 @@ def test_multi_round_sampling_draws_independently(model):
     assert not np.array_equal(out[:4], out[4:])
 
 
+def test_int8_kv_cache(model, prompt):
+    """kv_cache='int8': int8 rows + per-row scales, ~1/254 relative error;
+    composes with prefill and GQA-free decode alike."""
+    graph, params = model
+    ref_dec = PipelinedDecoder(graph, params, num_stages=4, microbatch=2,
+                               max_len=MAX_LEN)
+    q_dec = PipelinedDecoder(graph, params, num_stages=4, microbatch=2,
+                             max_len=MAX_LEN, kv_cache="int8")
+    assert q_dec._init_state()[1]["k"].dtype == jnp.int8
+    ref = ref_dec.generate(prompt, max_new_tokens=8)
+    got = q_dec.generate(prompt, max_new_tokens=8)
+    # tokens may differ where logits are within quant error; demand strong
+    # agreement on this tiny model and exact prompt echo
+    assert (got[:, :5] == prompt).all()
+    agree = (got == ref).mean()
+    assert agree > 0.9, (agree, got, ref)
+    # deterministic + prefill path works
+    np.testing.assert_array_equal(got, q_dec.generate(prompt, 8))
+    pre = q_dec.generate(prompt, max_new_tokens=8, prefill=True)
+    assert (pre == got).mean() > 0.9
+
+
+def test_quantize_row_roundtrip():
+    from defer_tpu.models.gpt import CausalTransformerBlock
+    rng = np.random.default_rng(0)
+    row = jnp.asarray(rng.standard_normal((3, 2, 7, 16)) * 5)
+    q, s = CausalTransformerBlock.quantize_row(row)
+    assert q.dtype == jnp.int8 and s.shape == (3, 2, 7)
+    dq = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+    err = np.abs(dq - np.asarray(row))
+    bound = np.abs(np.asarray(row)).max(-1) / 127.0 * 0.5 + 1e-7
+    assert (err <= bound[..., None] + 1e-5).all()
+
+
 def test_gqa_param_shapes():
     from defer_tpu.models.gpt import CausalTransformerBlock
     from defer_tpu.graph.ir import ShapeSpec
