@@ -241,7 +241,8 @@ def cmd_generate(args):
     vocab = graph.nodes["lm_head"].out_spec.shape[-1]
     max_len = graph.nodes["embeddings"].op.max_len
     dec = PipelinedDecoder(graph, params, num_stages=args.stages,
-                           microbatch=args.microbatch, max_len=max_len)
+                           microbatch=args.microbatch, max_len=max_len,
+                           kv_cache=args.kv_cache)
     rng = np.random.default_rng(args.seed)
     b = args.stages * args.microbatch
     prompt = rng.integers(0, vocab, (b, args.prompt_len)).astype(np.int32)
@@ -336,6 +337,9 @@ def main(argv=None):
     g.add_argument("--prefill", action="store_true",
                    help="fused full-sequence prompt prefill")
     g.add_argument("--token-chunk", type=int, default=None)
+    g.add_argument("--kv-cache", default="buffer",
+                   choices=["buffer", "int8"],
+                   help="int8: quantized KV cache (~1 byte/value reads)")
 
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition,
