@@ -239,9 +239,8 @@ def cmd_generate(args):
                          "the gpt* families")
     params = graph.init(jax.random.key(0))
     vocab = graph.nodes["lm_head"].out_spec.shape[-1]
-    max_len = graph.nodes["embeddings"].op.max_len
     dec = PipelinedDecoder(graph, params, num_stages=args.stages,
-                           microbatch=args.microbatch, max_len=max_len,
+                           microbatch=args.microbatch,
                            kv_cache=args.kv_cache)
     rng = np.random.default_rng(args.seed)
     b = args.stages * args.microbatch
@@ -257,6 +256,7 @@ def cmd_generate(args):
         "model": args.model, "stages": args.stages,
         "batch": b, "prompt_len": args.prompt_len,
         "new_tokens": args.new_tokens, "prefill": args.prefill,
+        "kv_cache": args.kv_cache,
         "tokens_per_s": round(b * args.new_tokens / dt, 2),
         "first_row": toks[0].tolist(),
     }))

@@ -105,7 +105,7 @@ class PipelinedDecoder:
         params: dict[str, Any],
         *,
         num_stages: int,
-        max_len: int,
+        max_len: int | None = None,
         mesh: Mesh | None = None,
         microbatch: int = 1,
         compute_dtype=None,
@@ -118,7 +118,6 @@ class PipelinedDecoder:
             raise ValueError(
                 f"mesh stage axis {self.mesh.shape[STAGE_AXIS]} != {n}")
         self.microbatch = mb = microbatch
-        self.max_len = max_len
         self.compute_dtype = jnp.dtype(compute_dtype) if compute_dtype \
             else jnp.dtype(jnp.float32)
         if kv_cache not in ("buffer", "int8"):
@@ -133,6 +132,9 @@ class PipelinedDecoder:
                     f"decoder graphs must follow the gpt() node contract; "
                     f"missing {req!r} (models/gpt.py)")
         self.embed_op: GptEmbedding = nodes["embeddings"].op
+        if max_len is None:
+            max_len = self.embed_op.max_len  # the positional table's reach
+        self.max_len = max_len
         if max_len > self.embed_op.max_len:
             raise ValueError(
                 f"max_len {max_len} exceeds the model's positional table "

@@ -125,6 +125,35 @@ class Defer:
             master_weights=cfg.master_weights,
         )
 
+    def generate(self, graph, params, prompt_ids, max_new_tokens: int,
+                 *, num_stages: int | None = None, max_len: int | None = None,
+                 kv_cache: str = "buffer", **sample_kw):
+        """Pipelined autoregressive generation (decoder graphs).
+
+        Convenience over :class:`~defer_tpu.runtime.decode.PipelinedDecoder`
+        with this deployment's mesh/config: partitions the causal graph's
+        blocks over ``num_stages`` (default: the mesh's stage axis, or 1),
+        decodes ``max_new_tokens`` past each prompt.  ``sample_kw`` passes
+        through (temperature, top_k, seed, eos_id, token_chunk, prefill).
+        """
+        from ..parallel.mesh import STAGE_AXIS
+        from .decode import PipelinedDecoder
+        if num_stages is None:
+            if self.mesh is not None:
+                if STAGE_AXIS not in self.mesh.shape:
+                    raise ValueError(
+                        f"mesh has no {STAGE_AXIS!r} axis; pass num_stages "
+                        "or a pipeline_mesh")
+                num_stages = self.mesh.shape[STAGE_AXIS]
+            else:
+                num_stages = 1
+        dec = PipelinedDecoder(
+            graph, params, num_stages=num_stages, mesh=self.mesh,
+            microbatch=self.config.microbatch, max_len=max_len,
+            compute_dtype=self.config.compute_dtype, kv_cache=kv_cache)
+        return dec.generate(np.asarray(prompt_ids), max_new_tokens,
+                            **sample_kw)
+
     # -- health ------------------------------------------------------------
 
     def health_check(self, graph, params, cut_points=None, num_stages=None):

@@ -264,6 +264,16 @@ def test_int8_kv_cache(model, prompt):
     assert (pre == got).mean() > 0.9
 
 
+def test_defer_generate_convenience(model, prompt):
+    """Defer.generate wires the decoder into the flagship API."""
+    import defer_tpu as dt
+    graph, params = model
+    defer = dt.Defer(config=dt.DeferConfig(microbatch=2))
+    got = defer.generate(graph, params, prompt, 6, num_stages=4)
+    want = incremental_greedy(graph, params, prompt, 5 + 6, MAX_LEN)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_quantize_row_roundtrip():
     from defer_tpu.models.gpt import CausalTransformerBlock
     rng = np.random.default_rng(0)
