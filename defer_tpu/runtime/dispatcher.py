@@ -19,6 +19,7 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..graph.ir import LayerGraph
@@ -97,6 +98,21 @@ class Defer:
         self.mesh = mesh
         self.config = config or DeferConfig()
 
+    def _default_num_stages(self) -> int:
+        """Stage count from this deployment's mesh (1 when mesh-less).
+
+        The single lookup both :meth:`generate` and :meth:`score` use — a
+        mesh without a stage axis errors clearly instead of silently
+        running single-stage."""
+        from ..parallel.mesh import STAGE_AXIS
+        if self.mesh is None:
+            return 1
+        if STAGE_AXIS not in self.mesh.shape:
+            raise ValueError(
+                f"mesh has no {STAGE_AXIS!r} axis; pass num_stages or a "
+                "pipeline_mesh")
+        return self.mesh.shape[STAGE_AXIS]
+
     # -- construction ------------------------------------------------------
 
     def build(self, graph: LayerGraph, params: dict[str, Any],
@@ -136,23 +152,54 @@ class Defer:
         decodes ``max_new_tokens`` past each prompt.  ``sample_kw`` passes
         through (temperature, top_k, seed, eos_id, token_chunk, prefill).
         """
-        from ..parallel.mesh import STAGE_AXIS
         from .decode import PipelinedDecoder
         if num_stages is None:
-            if self.mesh is not None:
-                if STAGE_AXIS not in self.mesh.shape:
-                    raise ValueError(
-                        f"mesh has no {STAGE_AXIS!r} axis; pass num_stages "
-                        "or a pipeline_mesh")
-                num_stages = self.mesh.shape[STAGE_AXIS]
-            else:
-                num_stages = 1
+            num_stages = self._default_num_stages()
         dec = PipelinedDecoder(
             graph, params, num_stages=num_stages, mesh=self.mesh,
             microbatch=self.config.microbatch, max_len=max_len,
             compute_dtype=self.config.compute_dtype, kv_cache=kv_cache)
         return dec.generate(np.asarray(prompt_ids), max_new_tokens,
                             **sample_kw)
+
+    def score(self, graph, params, ids, *, cut_points=None,
+              num_stages: int | None = None):
+        """Per-sequence log-likelihood of token ids under a causal LM.
+
+        ``ids``: [B, T] ints (B % microbatch == 0).  Runs the
+        full-sequence causal graph through the ordinary inference
+        pipeline and sums next-token log-probabilities.  Returns
+        ``(logprob [B], perplexity [B])`` — the evaluation-side companion
+        of :meth:`generate`.
+        """
+        ids = np.asarray(ids)
+        if ids.ndim != 2:
+            raise ValueError("ids must be [B, T]")
+        b, t = ids.shape
+        mb = self.config.microbatch
+        if b % mb or b == 0:
+            raise ValueError(
+                f"B={b} must be a non-zero multiple of microbatch={mb}")
+        if cut_points is None and num_stages is None:
+            num_stages = self._default_num_stages()
+        pipe = self.build(graph, params, cut_points, num_stages)
+        t_model = pipe.in_spec.shape[0]
+        if t > t_model:
+            raise ValueError(
+                f"sequence length {t} exceeds the model's {t_model}")
+        # causal attention: right-padding cannot influence positions < t,
+        # so pad to the graph's fixed length and score the real prefix
+        padded = np.zeros((b, t_model), ids.dtype)
+        padded[:, :t] = ids
+        logits = pipe.run(
+            padded.reshape(b // mb, mb, t_model).astype(np.float32))
+        logits = logits.reshape(b, t_model, -1)[:, :t]
+        logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+        tgt = jnp.asarray(ids[:, 1:], jnp.int32)
+        pick = jnp.take_along_axis(logp[:, :-1], tgt[..., None], -1)[..., 0]
+        total = np.asarray(pick.sum(axis=-1))
+        ppl = np.exp(-total / (t - 1)) if t > 1 else np.ones(b)
+        return total, ppl
 
     # -- health ------------------------------------------------------------
 

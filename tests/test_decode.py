@@ -274,6 +274,22 @@ def test_defer_generate_convenience(model, prompt):
     np.testing.assert_array_equal(got, want)
 
 
+def test_defer_score(model, prompt):
+    """Defer.score: pipeline log-likelihood == direct single-program."""
+    import defer_tpu as dt
+    graph, params = model
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, VOCAB, size=(4, 10)).astype(np.int32)
+    defer = dt.Defer(config=dt.DeferConfig(microbatch=2, chunk=4))
+    lp, ppl = defer.score(graph, params, ids, num_stages=4)
+    logits = np.asarray(graph.apply(params, jnp.asarray(ids)))
+    ref_logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), -1)
+    pick = jnp.take_along_axis(ref_logp[:, :-1],
+                               jnp.asarray(ids[:, 1:, None]), -1)[..., 0]
+    np.testing.assert_allclose(lp, np.asarray(pick.sum(-1)), rtol=1e-4)
+    assert (ppl > 0).all() and np.allclose(ppl, np.exp(-lp / 9), rtol=1e-6)
+
+
 def test_quantize_row_roundtrip():
     from defer_tpu.models.gpt import CausalTransformerBlock
     rng = np.random.default_rng(0)
