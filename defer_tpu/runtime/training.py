@@ -258,6 +258,31 @@ class PipelineTrainer:
             grads, self.opt_state, self.pipe._w)
         return float(loss)
 
+    def accumulate_step(self, batches) -> float:
+        """One optimizer step over SEVERAL chunks (gradient accumulation).
+
+        ``batches`` iterates ``(xs, ys)`` chunk pairs; gradients stay in
+        the stage-sharded buffer layout and sum on device (one lazy add
+        per chunk, no host round trips), then a single optimizer update
+        applies.  The effective batch is the sum of the chunks' — the
+        standard recipe when the target batch exceeds what one chunk's
+        in-flight window should hold.  Returns the summed loss.
+        """
+        total_loss = None  # device scalar until the end: no per-chunk sync
+        acc = None
+        for xs, ys in batches:
+            loss, grads = self.loss_and_grad(xs, ys)
+            total_loss = loss if total_loss is None else total_loss + loss
+            acc = grads if acc is None else jax.tree.map(
+                jnp.add, acc, grads)
+        if acc is None:
+            raise ValueError("accumulate_step needs at least one batch")
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init(self.pipe._w)
+        self.pipe._w, self.opt_state = self._apply_updates(
+            acc, self.opt_state, self.pipe._w)
+        return float(total_loss)
+
     # -- interop ------------------------------------------------------------
 
     def trained_params(self) -> dict[str, Any]:

@@ -67,6 +67,33 @@ def test_pipeline_grads_match_single_program(tiny, num_stages):
                                        rtol=5e-3, atol=5e-3)
 
 
+def test_accumulate_step_equals_one_big_chunk(tiny):
+    """Gradient accumulation: K chunks then one update == the single-chunk
+    update on the concatenated batch (same loss_fn sums per microbatch)."""
+    import optax
+    g, params = tiny
+    rng = np.random.default_rng(1)
+    xs = rng.standard_normal((4, 2, 32, 32, 3)).astype(np.float32)
+    ys = rng.integers(0, 10, (4, 2))
+
+    def make_trainer(chunk):
+        pipe = SpmdPipeline(partition(g, num_stages=2), params,
+                            mesh=pipeline_mesh(2), microbatch=2,
+                            chunk=chunk)
+        return PipelineTrainer(pipe, _loss, optimizer=optax.sgd(1e-2))
+
+    t_acc = make_trainer(2)
+    loss_acc = t_acc.accumulate_step([(xs[:2], ys[:2]), (xs[2:], ys[2:])])
+    t_one = make_trainer(4)
+    loss_one = t_one.step(xs, ys)
+    np.testing.assert_allclose(loss_acc, loss_one, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(t_acc.pipe._w),
+                               np.asarray(t_one.pipe._w),
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="at least one batch"):
+        t_acc.accumulate_step([])
+
+
 def test_train_step_reduces_loss(tiny):
     import optax
 
