@@ -290,6 +290,30 @@ def test_defer_score(model, prompt):
     assert (ppl > 0).all() and np.allclose(ppl, np.exp(-lp / 9), rtol=1e-6)
 
 
+def test_gqa_int8_prefill_sampling_compose(prompt):
+    """All decoder features at once: GQA + int8 cache + fused prefill +
+    top-k sampling + chunking + EOS, generating to the max_len boundary."""
+    graph = gpt_tiny(seq_len=MAX_LEN, vocab=VOCAB, kv_heads=1)
+    params = graph.init(jax.random.key(11))
+    dec = PipelinedDecoder(graph, params, num_stages=4, microbatch=2,
+                           max_len=MAX_LEN, kv_cache="int8")
+    new = MAX_LEN - 5  # generate right up to the positional-table edge
+    a = dec.generate(prompt, new, prefill=True, temperature=0.7,
+                     top_k=7, seed=3, token_chunk=4)
+    b = dec.generate(prompt, new, prefill=True, temperature=0.7,
+                     top_k=7, seed=3)  # single dispatch
+    np.testing.assert_array_equal(a, b)  # chunking-invariant end to end
+    assert a.shape == (8, MAX_LEN)
+    assert (a[:, :5] == prompt).all()
+    assert ((a >= 0) & (a < VOCAB)).all()
+    eos = int(a[0, 7])
+    c = dec.generate(prompt, new, prefill=True, temperature=0.7,
+                     top_k=7, seed=3, token_chunk=4, eos_id=eos)
+    gen = c[0, 5:]
+    hits = np.where(gen == eos)[0]
+    assert hits.size and (gen[hits[0]:] == eos).all()
+
+
 def test_quantize_row_roundtrip():
     from defer_tpu.models.gpt import CausalTransformerBlock
     rng = np.random.default_rng(0)
