@@ -110,6 +110,7 @@ class PipelinedDecoder:
         microbatch: int = 1,
         compute_dtype=None,
         kv_cache: str = "buffer",
+        beam_width: int = 1,
     ):
         self.graph = graph
         self.num_stages = n = num_stages
@@ -124,6 +125,12 @@ class PipelinedDecoder:
             raise ValueError(
                 f"kv_cache must be 'buffer' or 'int8', got {kv_cache!r}")
         self.kv_cache = kv_cache
+        if beam_width < 1 or mb % beam_width:
+            raise ValueError(
+                f"beam_width={beam_width} must be >= 1 and divide "
+                f"microbatch={mb} (each group's rows hold "
+                "microbatch/beam_width sequences x beam_width beams)")
+        self.beam_width = beam_width
 
         nodes = graph.nodes
         for req in ("embeddings", "final_ln", "lm_head"):
@@ -203,6 +210,9 @@ class PipelinedDecoder:
         #: per-row f32 scales for the int8 cache (one per head x position)
         self._scale_shape = (self.l_max, n + 1, mb, self.num_kv_heads,
                              max_len + 1)
+        #: ring-buffer width: beam mode adds one column carrying each
+        #: row's parent-beam index around the ring alongside the token id
+        self._ring_width = self.d_model + (1 if beam_width > 1 else 0)
         #: compiled decode programs keyed by (chunk_steps, sample, top_k) —
         #: repeat ``generate`` calls of a matching shape are dispatch-only
         self._decode_fns: dict[tuple, Any] = {}
@@ -240,6 +250,8 @@ class PipelinedDecoder:
         block_ops = [nodes[nm].op for nm in self.stage_blocks[s]]
         embed_op = self.embed_op
         int8 = self.kv_cache == "int8"
+        beam = self.beam_width
+        mb = self.microbatch
 
         def branch(w_local, a, caches, prompt, g, pos, plen, t, seed, temp,
                    first_ids, first_pos):
@@ -251,6 +263,35 @@ class PipelinedDecoder:
             valid = jnp.logical_and(pos >= 0, pos < self.max_len)
             safe_pos = jnp.clip(pos, 0, self.max_len - 1)
             write_pos = jnp.where(valid, safe_pos, self.max_len)
+
+            if beam > 1:
+                # re-parent this group's cache rows before appending the
+                # incoming token: its activation was computed from the
+                # CHOSEN beam's token, so history rows must match.  The
+                # parent indices ride the ring in the extra column.  Only
+                # beam-expansion arrivals (pos >= plen, non-bubble) carry
+                # real parents — the cond skips the full-cache gather on
+                # forced prompt steps and bubbles entirely.
+                parents = jnp.clip(
+                    jnp.round(a[:, self.d_model]).astype(jnp.int32),
+                    0, mb - 1)
+                applies = jnp.logical_and(valid, safe_pos >= plen)
+
+                def reparent_all(cs):
+                    def reparent(ent):
+                        # [Lmax, n+1, mb, ...] -> rows of group g gathered
+                        grp = lax.dynamic_slice(
+                            ent, (0, g) + (0,) * (ent.ndim - 2),
+                            (ent.shape[0], 1) + ent.shape[2:])
+                        grp = jnp.take(grp, parents, axis=2)
+                        return lax.dynamic_update_slice(
+                            ent, grp, (0, g) + (0,) * (ent.ndim - 2))
+
+                    return {nm: (reparent(c) if nm != "beam_cum" else c)
+                            for nm, c in cs.items()}
+
+                caches = lax.cond(applies, reparent_all,
+                                  lambda cs: cs, caches)
 
             if is_first:
                 recv_ids = jnp.round(a[:, 0]).astype(jnp.int32)
@@ -291,7 +332,41 @@ class PipelinedDecoder:
                 h = nodes["final_ln"].op.apply(p["final_ln"], x)
                 logits = nodes["lm_head"].op.apply(
                     p["lm_head"], h).astype(jnp.float32)
-                if sample:
+                a_out = jnp.zeros((mb, self._ring_width), jnp.float32)
+                if beam > 1:
+                    # beam expansion: per sequence, the best `beam` of
+                    # beam*V continuations by cumulative log-probability
+                    nseq = mb // beam
+                    vocab = logits.shape[-1]
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    cum = lax.dynamic_slice(caches["beam_cum"], (g, 0),
+                                            (1, mb))[0]
+                    sc = (cum.reshape(nseq, beam, 1)
+                          + logp.reshape(nseq, beam, vocab))
+                    # first expansion: every beam of a sequence is the
+                    # same prompt — keep only beam 0's continuations
+                    dup = jnp.logical_and(
+                        safe_pos == plen - 1,
+                        jnp.arange(beam)[None, :, None] > 0)
+                    sc = jnp.where(dup, -jnp.inf, sc)
+                    best, idx = lax.top_k(sc.reshape(nseq, beam * vocab),
+                                          beam)
+                    ids = (idx % vocab).reshape(mb)
+                    par = (jnp.arange(nseq)[:, None] * beam
+                           + idx // vocab).reshape(mb)
+                    new_cum = best.reshape(mb)
+                    # forced prompt steps keep identity/zero; bubbles keep
+                    # the table untouched
+                    forced = safe_pos < plen - 1
+                    ids = jnp.where(forced, jnp.argmax(logits, -1), ids)
+                    par = jnp.where(forced, jnp.arange(mb), par)
+                    keep = jnp.logical_or(forced, jnp.logical_not(valid))
+                    new_cum = jnp.where(keep, cum, new_cum)
+                    caches = dict(caches, beam_cum=lax.dynamic_update_slice(
+                        caches["beam_cum"], new_cum[None], (g, 0)))
+                    a_out = a_out.at[:, self.d_model].set(
+                        par.astype(jnp.float32))
+                elif sample:
                     # keyed by the global step so results are identical
                     # under any dispatch chunking; rows draw independently
                     ids = _sample_ids(
@@ -299,11 +374,14 @@ class PipelinedDecoder:
                         jax.random.fold_in(jax.random.PRNGKey(seed), t))
                 else:
                     ids = jnp.argmax(logits, axis=-1)
-                a_out = jnp.zeros((self.microbatch, self.d_model),
-                                  jnp.float32)
                 a_out = a_out.at[:, 0].set(ids.astype(jnp.float32))
             else:
                 a_out = x.astype(jnp.float32)
+                if beam > 1:
+                    # pass the incoming parent column onward unchanged —
+                    # every stage re-derives applicability from pos
+                    a_out = jnp.concatenate(
+                        [a_out, a[:, self.d_model:]], axis=-1)
             return a_out, caches
 
         return branch
@@ -390,10 +468,15 @@ class PipelinedDecoder:
     def _state_specs(self):
         """shard_map spec pytree for the cache-state dict."""
         spec7 = P(STAGE_AXIS, None, None, None, None, None, None)
+        specs = {"k": spec7, "v": spec7}
         if self.kv_cache == "int8":
             spec6 = P(STAGE_AXIS, None, None, None, None, None)
-            return {"k": spec7, "v": spec7, "ks": spec6, "vs": spec6}
-        return {"k": spec7, "v": spec7}
+            specs.update(ks=spec6, vs=spec6)
+        if self.beam_width > 1:
+            # per-group cumulative beam scores; only the LAST stage's
+            # device shard is meaningful (it runs the expansion)
+            specs["beam_cum"] = P(STAGE_AXIS, None, None)
+        return specs
 
     def _build_prefill_fn(self, plen: int, sample: bool, top_k: int | None):
         n = self.num_stages
@@ -454,7 +537,10 @@ class PipelinedDecoder:
                                              jnp.float32)
                     caches["vs"] = jnp.zeros((n,) + self._scale_shape,
                                              jnp.float32)
-                return jnp.zeros((n, mb, d), jnp.float32), caches
+                if self.beam_width > 1:
+                    caches["beam_cum"] = jnp.zeros((n, n, mb), jnp.float32)
+                return (jnp.zeros((n, mb, self._ring_width), jnp.float32),
+                        caches)
 
             self._init_fn = jax.jit(
                 zeros, out_shardings=(act_sh, state_sh))
@@ -465,9 +551,11 @@ class PipelinedDecoder:
         n = self.num_stages
         perm = [(k, (k + 1) % n) for k in range(n)]
         branches = [self._make_branch(s, sample, top_k) for s in range(n)]
+        beam = self.beam_width > 1
+        d = self.d_model
 
-        def device_decode(w, prompt, plen, t0, seed, temp, first_ids,
-                          first_pos, start, a, caches):
+        def device_decode(w, prompt, plen, t0, t_stop, seed, temp,
+                          first_ids, first_pos, start, a, caches):
             w_l = w[0]
             idx = lax.axis_index(STAGE_AXIS)
             local = jax.tree.map(lambda c: c[0], caches)
@@ -475,18 +563,24 @@ class PipelinedDecoder:
             def body(carry, t):
                 a, caches = carry
                 # stage idx serves group (t - idx) mod n at token position
-                # start + (t - idx)//n; negative skew = warmup bubble
+                # start + (t - idx)//n; negative skew = warmup bubble, and
+                # chunk-overshoot steps (t >= t_stop) are bubbles too —
+                # they must not touch caches or the beam ledger
                 rel = t - idx
-                g = jnp.where(rel >= 0, rel % n, 0)
-                pos = jnp.where(rel >= 0, start + rel // n, -1)
+                live = jnp.logical_and(rel >= 0, t < t_stop)
+                g = jnp.where(live, rel % n, 0)
+                pos = jnp.where(live, start + rel // n, -1)
                 a_out, caches = lax.switch(
                     idx, branches, w_l, a, caches, prompt, g, pos, plen,
                     t, seed, temp, first_ids, first_pos)
                 a_next = lax.ppermute(a_out, STAGE_AXIS, perm)
-                # emit what just arrived on the wrap link: ids sampled by
-                # the last stage, readable on device 0 (runtime/spmd.py
-                # emits the same slice for the inference pipeline)
-                return (a_next, caches), a_next[:, 0]
+                # emit what just arrived on the wrap link: ids (and, under
+                # beam search, parent indices) chosen by the last stage,
+                # readable on device 0 (runtime/spmd.py emits the same
+                # slice for the inference pipeline)
+                emit = (jnp.stack([a_next[:, 0], a_next[:, d]], axis=-1)
+                        if beam else a_next[:, 0])
+                return (a_next, caches), emit
 
             (a, local), ids = lax.scan(
                 body, (a[0], local),
@@ -495,19 +589,43 @@ class PipelinedDecoder:
                     ids[None])
 
         state = self._state_specs()
+        out_ids = P(STAGE_AXIS, None, None, None) if beam \
+            else P(STAGE_AXIS, None, None)
         fn = jax.shard_map(
             device_decode, mesh=self.mesh,
             in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
-                      P(), P(), P(None, None), P(), P(),
+                      P(), P(), P(), P(None, None), P(), P(),
                       P(STAGE_AXIS, None, None), state),
-            out_specs=(P(STAGE_AXIS, None, None), state,
-                       P(STAGE_AXIS, None, None)),
+            out_specs=(P(STAGE_AXIS, None, None), state, out_ids),
             check_vma=False,
         )
         # donate the carried state so chunked dispatches update in place
-        return jax.jit(fn, donate_argnums=(9, 10))
+        return jax.jit(fn, donate_argnums=(10, 11))
 
     # ------------------------------------------------------------------
+
+    def _schedule(self, t_tok: int, start: int,
+                  token_chunk: int | None) -> tuple[int, int]:
+        """(num_steps, chunk_steps) for decoding positions (start, t_tok).
+
+        The last needed step emits position t_tok-1 of the last group:
+        ``(n-1) + n*(t_tok-2-start) + (n-1)``; one schedule shared by the
+        greedy/sampling and beam paths."""
+        n = self.num_stages
+        num_steps = (n - 1) + n * (t_tok - 2 - start) + (n - 1) + 1 \
+            if t_tok - 1 > start else 0
+        chunk_steps = max(num_steps, n) if token_chunk is None \
+            else max(n, n * int(token_chunk))
+        return num_steps, chunk_steps
+
+    def _get_decode_fn(self, chunk_steps: int, sample: bool,
+                       top_k: int | None):
+        key = (chunk_steps, sample, top_k)
+        fn = self._decode_fns.get(key)
+        if fn is None:
+            fn = self._decode_fns[key] = \
+                self._build_decode_fn(chunk_steps, sample, top_k)
+        return fn
 
     def _gather_init(self, prompt: np.ndarray, plen: int, t_tok: int,
                      start: int,
@@ -577,6 +695,13 @@ class PipelinedDecoder:
             raise ValueError("prompt must contain at least one token "
                              "(position 0 has nothing to condition on)")
         n, mb = self.num_stages, self.microbatch
+        if self.beam_width > 1:
+            if prefill or eos_id is not None or float(temperature) > 0:
+                raise ValueError(
+                    "beam search currently composes with neither prefill, "
+                    "eos_id, nor temperature sampling")
+            return self._generate_beam(prompt_ids, max_new_tokens,
+                                       token_chunk=token_chunk)
         if b % mb or b == 0:
             raise ValueError(
                 f"B={b} must be a non-zero multiple of microbatch={mb}")
@@ -630,18 +755,9 @@ class PipelinedDecoder:
             first_ids_np = None
             start = 0
 
-        # last needed decode step: position t_tok-1 of the last group
-        # (see _gather); with prefill, position `start` is already known
-        num_steps = (n - 1) + n * (t_tok - 2 - start) + (n - 1) + 1 \
-            if t_tok - 1 > start else 0
-        chunk_steps = max(num_steps, n) if token_chunk is None \
-            else max(n, n * int(token_chunk))
-
-        cache_key = (chunk_steps, sample, top_k)
-        fn = self._decode_fns.get(cache_key)
-        if fn is None:
-            fn = self._decode_fns[cache_key] = \
-                self._build_decode_fn(chunk_steps, sample, top_k)
+        # with prefill, position `start` is already known (first_ids)
+        num_steps, chunk_steps = self._schedule(t_tok, start, token_chunk)
+        fn = self._get_decode_fn(chunk_steps, sample, top_k)
 
         fi_dev = jnp.asarray(first_ids_np if first_ids_np is not None
                              else np.zeros((n, mb), np.int32))
@@ -653,8 +769,9 @@ class PipelinedDecoder:
         steps_run = 0
         while steps_run < num_steps:
             a, caches, ids = fn(self._w, prompt_dev, plen_s,
-                                jnp.int32(steps_run), seed_s, temp_s,
-                                fi_dev, fp_s, start_s, a, caches)
+                                jnp.int32(steps_run), jnp.int32(num_steps),
+                                seed_s, temp_s, fi_dev, fp_s, start_s,
+                                a, caches)
             if eos_id is not None:
                 # incremental scatter of just this chunk: linear host work
                 self._gather_into(out3, np.asarray(ids[0]), steps_run,
@@ -683,4 +800,76 @@ class PipelinedDecoder:
             first = np.where(hit.any(1), hit.argmax(1), gen.shape[1])
             mask = np.arange(gen.shape[1])[None, :] > first[:, None]
             gen[mask] = eos_id
+        return out
+
+    def _generate_beam(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                       *, token_chunk: int | None) -> np.ndarray:
+        """Pipelined beam search; returns each prompt's best sequence.
+
+        Each prompt occupies ``beam_width`` adjacent microbatch rows.  The
+        last stage expands beams (top ``beam`` of beam*V continuations by
+        cumulative log-probability, duplicate-masked on the first
+        expansion) and the chosen parent indices ride the ring's extra
+        column so every stage re-parents its cache rows before appending
+        (see ``_make_branch``).  The host backtracks the recorded
+        (token, parent) pairs and picks the best final beam per prompt.
+        """
+        n, mb, beam = self.num_stages, self.microbatch, self.beam_width
+        b, plen = prompt_ids.shape
+        nspg = mb // beam  # sequences per group
+        if b % nspg or b == 0:
+            raise ValueError(
+                f"B={b} must be a non-zero multiple of "
+                f"microbatch/beam_width = {nspg}")
+        if b > n * nspg:
+            return np.concatenate(
+                [self._generate_beam(prompt_ids[lo: lo + n * nspg],
+                                     max_new_tokens,
+                                     token_chunk=token_chunk)
+                 for lo in range(0, b, n * nspg)], axis=0)
+        t_tok = plen + max_new_tokens
+        if t_tok > self.max_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {t_tok} exceeds "
+                f"max_len={self.max_len}")
+
+        # each prompt duplicated over its beam rows
+        rows = np.repeat(prompt_ids, beam, axis=0)
+        prompt = np.zeros((n, mb, plen), np.int32)
+        prompt.reshape(n * mb, plen)[: rows.shape[0]] = rows
+        if t_tok == plen:
+            return prompt_ids.astype(np.int64)
+
+        num_steps, chunk_steps = self._schedule(t_tok, 0, token_chunk)
+        fn = self._get_decode_fn(chunk_steps, False, None)
+
+        prompt_dev = jnp.asarray(prompt)
+        zero = jnp.int32(0)
+        fi_dev = jnp.zeros((n, mb), jnp.int32)
+        a, caches = self._init_state()
+        chunks = []
+        steps_run = 0
+        while steps_run < num_steps:
+            a, caches, ids = fn(self._w, prompt_dev, jnp.int32(plen),
+                                jnp.int32(steps_run), jnp.int32(num_steps),
+                                jnp.uint32(0), jnp.float32(0.0), fi_dev,
+                                jnp.int32(-1), zero, a, caches)
+            chunks.append(ids)
+            steps_run += chunk_steps
+        arr = np.concatenate([np.asarray(c[0]) for c in chunks], axis=0)
+        toks = np.round(arr[..., 0]).astype(np.int64)   # [T, mb]
+        pars = np.round(arr[..., 1]).astype(np.int64)
+        # final cumulative scores live on the last stage's shard
+        cum = np.asarray(caches["beam_cum"])[n - 1]      # [n_groups, mb]
+
+        out = np.zeros((b, t_tok), np.int64)
+        out[:, :plen] = prompt_ids
+        for s in range(b):
+            g, si = divmod(s, nspg)
+            row_lo = si * beam
+            r = row_lo + int(np.argmax(cum[g, row_lo: row_lo + beam]))
+            for p in range(t_tok - 1, plen - 1, -1):
+                t = (n - 1) + n * (p - 1) + g
+                out[s, p] = toks[t, r]
+                r = int(pars[t, r])
         return out

@@ -314,6 +314,106 @@ def test_gqa_int8_prefill_sampling_compose(prompt):
     assert hits.size and (gen[hits[0]:] == eos).all()
 
 
+def reference_beam(graph, params, prompt, max_new, beam, max_len):
+    """Single-device beam search from the same decode ops + expansion math
+    (flat top-k of beam*V cumulative log-probs, duplicate-masked first
+    expansion, cache re-parenting before each append)."""
+    nodes = graph.nodes
+    blocks = [nm for nm in graph.topo_order if nm.startswith("block_")]
+    op0 = nodes[blocks[0]].op
+    d = nodes[blocks[0]].out_spec.shape[-1]
+    vocab = nodes["lm_head"].out_spec.shape[-1]
+    b, plen = prompt.shape
+    t_tok = plen + max_new
+    outs = []
+    for s in range(b):
+        seqs = np.tile(prompt[s], (beam, 1)).astype(np.int64)
+        shape = (beam, op0.kv_heads, max_len + 1, d // op0.num_heads)
+        kc = {nm: jnp.zeros(shape) for nm in blocks}
+        vc = {nm: jnp.zeros(shape) for nm in blocks}
+        cum = jnp.zeros(beam)
+        for p in range(t_tok - 1):
+            tok = jnp.asarray(seqs[:, p], jnp.int32)
+            x = nodes["embeddings"].op.embed_at(params["embeddings"],
+                                                tok, p)
+            for nm in blocks:
+                x, kc[nm], vc[nm] = nodes[nm].op.decode(
+                    params[nm], x, kc[nm], vc[nm], p)
+            if p < plen - 1:
+                continue  # forced prompt token; no expansion
+            h = nodes["final_ln"].op.apply(params["final_ln"], x)
+            logits = nodes["lm_head"].op.apply(
+                params["lm_head"], h).astype(jnp.float32)
+            sc = cum[:, None] + jax.nn.log_softmax(logits, -1)
+            if p == plen - 1:
+                sc = sc.at[1:].set(-jnp.inf)
+            best, idx = jax.lax.top_k(sc.reshape(1, beam * vocab), beam)
+            parent = np.asarray(idx[0] // vocab)
+            new_tok = np.asarray(idx[0] % vocab, np.int64)
+            cum = best[0]
+            seqs = np.concatenate([seqs[parent],
+                                   new_tok[:, None]], axis=1)
+            kc = {nm: jnp.take(kc[nm], jnp.asarray(parent), axis=0)
+                  for nm in blocks}
+            vc = {nm: jnp.take(vc[nm], jnp.asarray(parent), axis=0)
+                  for nm in blocks}
+        outs.append(seqs[int(np.argmax(np.asarray(cum)))])
+    return np.stack(outs)
+
+
+@pytest.mark.parametrize("num_stages,microbatch,beam", [(4, 4, 2), (2, 6, 3),
+                                                        (1, 4, 4)])
+def test_pipelined_beam_matches_reference(model, prompt, num_stages,
+                                          microbatch, beam):
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=num_stages,
+                           microbatch=microbatch, max_len=MAX_LEN,
+                           beam_width=beam)
+    nspg = microbatch // beam
+    b = min(8, num_stages * nspg)
+    b -= b % nspg
+    got = dec.generate(prompt[:b], max_new_tokens=8)
+    want = reference_beam(graph, params, prompt[:b], 8, beam, MAX_LEN)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_with_chunked_dispatch(model, prompt):
+    """Chunk-overshoot steps must be true bubbles: with token_chunk the
+    final dispatch overruns num_steps, and an un-guarded extra expansion
+    would corrupt the beam ledger before the host picks the best beam."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN, beam_width=2)
+    whole = dec.generate(prompt[:4], max_new_tokens=7)
+    chunked = dec.generate(prompt[:4], max_new_tokens=7, token_chunk=1)
+    np.testing.assert_array_equal(whole, chunked)
+    want = reference_beam(graph, params, prompt[:4], 7, 2, MAX_LEN)
+    np.testing.assert_array_equal(whole, want)
+
+
+def test_beam_one_equals_greedy(model, prompt):
+    graph, params = model
+    greedy = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                              max_len=MAX_LEN)
+    beam1 = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                             max_len=MAX_LEN, beam_width=1)
+    np.testing.assert_array_equal(greedy.generate(prompt, 6),
+                                  beam1.generate(prompt, 6))
+
+
+def test_beam_validation(model, prompt):
+    graph, params = model
+    with pytest.raises(ValueError, match="divide"):
+        PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                         max_len=MAX_LEN, beam_width=3)
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN, beam_width=2)
+    with pytest.raises(ValueError, match="beam search"):
+        dec.generate(prompt[:4], 4, prefill=True)
+    with pytest.raises(ValueError, match="beam search"):
+        dec.generate(prompt[:4], 4, temperature=0.5)
+
+
 def test_quantize_row_roundtrip():
     from defer_tpu.models.gpt import CausalTransformerBlock
     rng = np.random.default_rng(0)
