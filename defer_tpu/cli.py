@@ -241,13 +241,15 @@ def cmd_generate(args):
     vocab = graph.nodes["lm_head"].out_spec.shape[-1]
     dec = PipelinedDecoder(graph, params, num_stages=args.stages,
                            microbatch=args.microbatch,
-                           kv_cache=args.kv_cache)
+                           kv_cache=args.kv_cache,
+                           beam_width=args.beam)
     rng = np.random.default_rng(args.seed)
-    b = args.stages * args.microbatch
+    b = args.stages * (args.microbatch // args.beam)
     prompt = rng.integers(0, vocab, (b, args.prompt_len)).astype(np.int32)
-    kw = dict(temperature=args.temperature, top_k=args.top_k,
-              seed=args.seed, prefill=args.prefill,
-              token_chunk=args.token_chunk)
+    kw = dict(token_chunk=args.token_chunk)
+    if args.beam == 1:
+        kw.update(temperature=args.temperature, top_k=args.top_k,
+                  seed=args.seed, prefill=args.prefill)
     dec.generate(prompt, args.new_tokens, **kw)   # compile
     t0 = time.perf_counter()
     toks = dec.generate(prompt, args.new_tokens, **kw)   # warm
@@ -256,7 +258,7 @@ def cmd_generate(args):
         "model": args.model, "stages": args.stages,
         "batch": b, "prompt_len": args.prompt_len,
         "new_tokens": args.new_tokens, "prefill": args.prefill,
-        "kv_cache": args.kv_cache,
+        "kv_cache": args.kv_cache, "beam": args.beam,
         "tokens_per_s": round(b * args.new_tokens / dt, 2),
         "first_row": toks[0].tolist(),
     }))
@@ -340,6 +342,8 @@ def main(argv=None):
     g.add_argument("--kv-cache", default="buffer",
                    choices=["buffer", "int8"],
                    help="int8: quantized KV cache (~1 byte/value reads)")
+    g.add_argument("--beam", type=int, default=1,
+                   help="beam width (must divide --microbatch)")
 
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition,
