@@ -167,6 +167,9 @@ def main():
                     help="pipeline microbatch sweep")
     ap.add_argument("--quick", action="store_true",
                     help="small sweep: batches 1,32; one pipeline config")
+    ap.add_argument("--fold-bn", action="store_true",
+                    help="fold BatchNorm into convs before deployment "
+                         "(graph/optimize.py); exact at f32")
     args = ap.parse_args()
 
     devices = init_devices()
@@ -211,7 +214,13 @@ def main():
             log("bench: --weights ignored on the CPU fallback "
                 "(tiny model, random init)")
         params = graph.init(jax.random.key(0))
-    flops_img = float(total_flops(graph))  # per-sample (2*MAC convention)
+    if args.fold_bn:
+        from defer_tpu import fold_batchnorm
+        graph, params, n_folded = fold_batchnorm(graph, params)
+        log(f"bench: folded {n_folded} BatchNorm ops into convs")
+    # per-sample FLOPs (2*MAC convention) of the graph as DEPLOYED — after
+    # any folding, so MFU is scored against the work actually executed
+    flops_img = float(total_flops(graph))
     log(f"bench: model FLOPs/img = {flops_img / 1e9:.2f} G")
 
     # ---- single-chip baseline + batch sweep (test/local_infer.py protocol)

@@ -20,6 +20,7 @@ Quick start::
 from . import models
 from .graph.analysis import auto_cut_points, total_flops, valid_cut_points
 from .graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
+from .graph.optimize import fold_batchnorm
 from .graph.viz import summary, to_dot
 from .ops import flash_attention
 from .codec import (BlockFloatCodec, Codec, LosslessCodec, PipelineCodec,
@@ -53,6 +54,7 @@ __version__ = "0.1.0"
 __all__ = [
     "GraphBuilder", "LayerGraph", "Op", "ShapeSpec", "StageSpec",
     "partition", "valid_cut_points", "auto_cut_points", "total_flops",
+    "fold_batchnorm",
     "summary", "to_dot",
     "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",
     "SpmdPipeline", "MpmdPipeline", "PipelineTrainer", "PipelinedDecoder",

@@ -246,10 +246,11 @@ def cmd_generate(args):
     rng = np.random.default_rng(args.seed)
     b = args.stages * (args.microbatch // args.beam)
     prompt = rng.integers(0, vocab, (b, args.prompt_len)).astype(np.int32)
-    kw = dict(token_chunk=args.token_chunk)
-    if args.beam == 1:
-        kw.update(temperature=args.temperature, top_k=args.top_k,
-                  seed=args.seed, prefill=args.prefill)
+    # pass everything through: incompatible combinations (e.g. beam +
+    # prefill) surface as the decoder's ValueError instead of a silently
+    # different configuration than the JSON record claims
+    kw = dict(token_chunk=args.token_chunk, temperature=args.temperature,
+              top_k=args.top_k, seed=args.seed, prefill=args.prefill)
     dec.generate(prompt, args.new_tokens, **kw)   # compile
     t0 = time.perf_counter()
     toks = dec.generate(prompt, args.new_tokens, **kw)   # warm

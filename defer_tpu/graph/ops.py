@@ -142,23 +142,30 @@ class DepthwiseConv2D(Op):
     kernel: int = 3
     stride: int = 1
     padding: str = "SAME"
+    use_bias: bool = False  # enabled by the BatchNorm-folding pass
 
     def init(self, key, in_specs):
         (spec,) = in_specs
         c = spec.shape[-1]
         k = self.kernel
-        return {"w": jax.random.normal(key, (k, k, 1, c), jnp.float32)
-                * math.sqrt(2.0 / (k * k))}
+        p = {"w": jax.random.normal(key, (k, k, 1, c), jnp.float32)
+             * math.sqrt(2.0 / (k * k))}
+        if self.use_bias:
+            p["b"] = jnp.zeros((c,), jnp.float32)
+        return p
 
     def apply(self, params, x):
         p = _cast(params, x.dtype)
         c = x.shape[-1]
-        return lax.conv_general_dilated(
+        y = lax.conv_general_dilated(
             x, p["w"], window_strides=(self.stride, self.stride),
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=c,
         )
+        if self.use_bias:
+            y = y + p["b"]
+        return y
 
     def flops(self, in_specs, out_spec):
         return 2 * out_spec.size * self.kernel * self.kernel

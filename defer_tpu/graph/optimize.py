@@ -1,0 +1,100 @@
+"""Graph optimization passes (deployment-time rewrites).
+
+The reference ships partitions exactly as authored (reference
+src/dispatcher.py:40-49); a framework that owns its graph IR can rewrite
+it before compilation.  First pass: **BatchNorm folding** — inference-mode
+batch norm is an affine map per channel, so it folds exactly into the
+preceding convolution's weights and bias, removing the op (and its HBM
+round trip wherever XLA would not have fused it) from every stage program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .ir import LayerGraph, LayerNode
+from .ops import BatchNorm, Conv2D, DepthwiseConv2D
+
+
+def _consumers(graph: LayerGraph, name: str) -> list[str]:
+    return [n.name for n in graph.nodes.values() if name in n.inputs]
+
+
+def fold_batchnorm(graph: LayerGraph, params: dict[str, Any]
+                   ) -> tuple[LayerGraph, dict[str, Any], int]:
+    """Fold inference BatchNorm into the preceding (depthwise) conv.
+
+    For every ``conv -> bn`` pair where the conv output feeds ONLY the bn
+    (and is not the graph output), rewrites
+
+        bn(conv(x)) == conv'(x),  w' = w * g/sqrt(v+eps),
+                                  b' = (b - mean) * g/sqrt(v+eps) + beta
+
+    exactly (f32 arithmetic), drops the bn node, and rewires its
+    consumers.  Returns ``(new_graph, new_params, folded_count)``; the
+    inputs are left untouched.
+    """
+    nodes = dict(graph.nodes)
+    new_params = dict(params)
+    rename: dict[str, str] = {}  # bn name -> conv name
+    folded = 0
+
+    for bn_name, bn_node in graph.nodes.items():
+        if not isinstance(bn_node.op, BatchNorm):
+            continue
+        (src,) = bn_node.inputs
+        conv_node = nodes.get(src)
+        if conv_node is None:  # graph input feeds the bn
+            continue
+        if not isinstance(conv_node.op, (Conv2D, DepthwiseConv2D)):
+            continue
+        if len(_consumers(graph, src)) != 1 or graph.output_name == src:
+            continue
+
+        bnp = params[bn_name]
+        inv = np.asarray(bnp["scale"], np.float64) / np.sqrt(
+            np.asarray(bnp["var"], np.float64) + bn_node.op.eps)
+        cp = dict(params[src])
+        w = np.asarray(cp["w"], np.float64)
+        cp["w"] = (w * inv).astype(np.float32)  # out-channel dim is last
+        b = np.asarray(cp.get("b", np.zeros(w.shape[-1])), np.float64)
+        cp["b"] = ((b - np.asarray(bnp["mean"], np.float64)) * inv
+                   + np.asarray(bnp["bias"], np.float64)).astype(np.float32)
+
+        op = dataclasses.replace(conv_node.op, use_bias=True)
+        param_spec = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), jnp.float32), cp)
+        nodes[src] = LayerNode(src, op, conv_node.inputs,
+                               conv_node.out_spec, param_spec)
+        new_params[src] = cp
+        del nodes[bn_name]
+        new_params.pop(bn_name, None)
+        rename[bn_name] = src
+        folded += 1
+
+    if not folded:
+        return graph, params, 0
+
+    # rewire consumers of removed bn nodes (chase chains of renames)
+    def resolve(name: str) -> str:
+        while name in rename:
+            name = rename[name]
+        return name
+
+    rewired = {}
+    for name, node in nodes.items():
+        inputs = tuple(resolve(i) for i in node.inputs)
+        if inputs != node.inputs:
+            node = LayerNode(name, node.op, inputs, node.out_spec,
+                             node.param_spec)
+        rewired[name] = node
+
+    out = LayerGraph(graph.name + "+bnfold", rewired, graph.input_name,
+                     resolve(graph.output_name), graph.input_spec)
+    return out, new_params, folded

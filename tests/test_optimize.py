@@ -1,0 +1,71 @@
+"""Graph optimization passes: BatchNorm folding."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from defer_tpu import SpmdPipeline, partition, pipeline_mesh
+from defer_tpu.graph.optimize import fold_batchnorm
+from defer_tpu.graph.ops import BatchNorm
+from defer_tpu.models import mobilenet_tiny, resnet_tiny
+
+
+def _randomized_bn_params(graph, params, seed):
+    """Non-trivial running stats so folding is actually exercised."""
+    rng = np.random.default_rng(seed)
+    out = dict(params)
+    for name, node in graph.nodes.items():
+        if isinstance(node.op, BatchNorm):
+            c = np.shape(params[name]["mean"])[0]
+            out[name] = {
+                "scale": jnp.asarray(rng.uniform(0.5, 1.5, c), jnp.float32),
+                "bias": jnp.asarray(rng.normal(0, 0.2, c), jnp.float32),
+                "mean": jnp.asarray(rng.normal(0, 0.5, c), jnp.float32),
+                "var": jnp.asarray(rng.uniform(0.5, 2.0, c), jnp.float32),
+            }
+    return out
+
+
+@pytest.mark.parametrize("model_fn", [resnet_tiny, mobilenet_tiny])
+def test_fold_batchnorm_preserves_outputs(model_fn):
+    graph = model_fn()
+    params = _randomized_bn_params(graph, graph.init(jax.random.key(0)), 1)
+    folded_graph, folded_params, n = fold_batchnorm(graph, params)
+    assert n > 0
+    n_bn = sum(isinstance(nd.op, BatchNorm)
+               for nd in folded_graph.nodes.values())
+    n_bn_orig = sum(isinstance(nd.op, BatchNorm)
+                    for nd in graph.nodes.values())
+    assert n_bn == n_bn_orig - n
+    x = jnp.asarray(
+        np.random.default_rng(2).standard_normal(
+            (2,) + graph.input_spec.shape), jnp.float32)
+    ref = np.asarray(graph.apply(params, x))
+    got = np.asarray(folded_graph.apply(folded_params, x))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # originals untouched
+    assert any(isinstance(nd.op, BatchNorm) for nd in graph.nodes.values())
+
+
+def test_folded_graph_runs_in_pipeline():
+    graph = resnet_tiny()
+    params = _randomized_bn_params(graph, graph.init(jax.random.key(3)), 4)
+    fg, fp, n = fold_batchnorm(graph, params)
+    assert n > 0
+    pipe = SpmdPipeline(partition(fg, num_stages=4), fp,
+                        mesh=pipeline_mesh(4), microbatch=1, chunk=4)
+    x = np.random.default_rng(5).standard_normal(
+        (3, 1) + graph.input_spec.shape).astype(np.float32)
+    got = pipe.run(x)
+    ref = np.stack([np.asarray(graph.apply(params, xi)) for xi in x])
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fold_noop_without_bn():
+    from defer_tpu.models import gpt_tiny
+    g = gpt_tiny()
+    p = g.init(jax.random.key(0))
+    g2, p2, n = fold_batchnorm(g, p)
+    assert n == 0 and g2 is g and p2 is p
