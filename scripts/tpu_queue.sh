@@ -1,0 +1,35 @@
+#!/bin/bash
+# Measurement queue fired when the axon tunnel recovers (see the nohup
+# retry loop): decode bench -> BatchNorm-folding comparison rows.
+set -u
+cd "${1:-/root/repo}"
+
+echo "[queue] $(date +%H:%M:%S) bench_decode" >&2
+timeout 2400 python scripts/bench_decode.py > DECODE_r04.json \
+    2> /tmp/decode_r04.err
+echo "[queue] decode rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[queue] $(date +%H:%M:%S) fold-bn comparison (quick bench x2)" >&2
+DEFER_BENCH_REQUIRE_TPU=1 timeout 1500 python bench.py --quick \
+    > /tmp/bench_nofold.json 2> /tmp/bench_nofold.err
+echo "[queue] nofold rc=$?" >&2
+DEFER_BENCH_REQUIRE_TPU=1 timeout 1500 python bench.py --quick --fold-bn \
+    > /tmp/bench_fold.json 2> /tmp/bench_fold.err
+echo "[queue] fold rc=$? $(date +%H:%M:%S)" >&2
+python - <<'EOF' > FOLDBN_r04.json
+import json
+rows = {}
+for tag, path in (("baseline", "/tmp/bench_nofold.json"),
+                  ("fold_bn", "/tmp/bench_fold.json")):
+    try:
+        with open(path) as f:
+            d = json.loads(f.read().strip().splitlines()[-1])
+        rows[tag] = {"pipeline_img_per_s": d["value"],
+                     "single_chip_best_img_per_s":
+                         d["single_chip_best_img_per_s"],
+                     "flops_per_img": d["flops_per_img"]}
+    except Exception as e:  # noqa: BLE001
+        rows[tag] = {"error": repr(e)[:200]}
+print(json.dumps({"metric": "resnet50_fold_bn_comparison", **rows}))
+EOF
+echo "[queue] done" >&2
