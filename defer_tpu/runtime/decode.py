@@ -663,7 +663,8 @@ class PipelinedDecoder:
                  temperature: float = 0.0, top_k: int | None = None,
                  seed: int = 0, eos_id: int | None = None,
                  token_chunk: int | None = None,
-                 prefill: bool = False) -> np.ndarray:
+                 prefill: bool = False,
+                 on_tokens=None) -> np.ndarray:
         """Decode ``max_new_tokens`` past each prompt.
 
         ``prompt_ids``: [B, prompt_len] ints, B % microbatch == 0; batches
@@ -686,6 +687,15 @@ class PipelinedDecoder:
         prompt cost drops from ``plen * n`` ring steps to ``2n - 1``.
         Greedy results are identical up to float reduction order; sampled
         results use a different key for the first generated token.
+
+        ``on_tokens(lo, hi, tokens, rows=(r0, r1))`` streams newly
+        decodable positions to the caller after each chunk dispatch:
+        ``tokens`` is [r1-r0, hi-lo] for positions [lo, hi) of sequence
+        rows [r0, r1) (generated region only; rows=(0, B) unless the
+        batch spans several pipeline-fill rounds) — pair with
+        ``token_chunk`` for incremental delivery.  With ``eos_id``,
+        streamed tokens past a sequence's EOS are garbage the final
+        result replaces with ``eos_id``.
         """
         prompt_ids = np.asarray(prompt_ids)
         if prompt_ids.ndim != 2:
@@ -700,6 +710,10 @@ class PipelinedDecoder:
                 raise ValueError(
                     "beam search currently composes with neither prefill, "
                     "eos_id, nor temperature sampling")
+            if on_tokens is not None:
+                raise ValueError(
+                    "beam search cannot stream tokens (sequences are only "
+                    "final after the last re-parenting)")
             return self._generate_beam(prompt_ids, max_new_tokens,
                                        token_chunk=token_chunk)
         if b % mb or b == 0:
@@ -710,13 +724,21 @@ class PipelinedDecoder:
             # Each round derives its own seed — otherwise identical
             # prompts in different rounds would sample identical
             # continuations (the step keys restart at t=0 every round).
-            return np.concatenate(
-                [self.generate(prompt_ids[lo: lo + n * mb],
-                               max_new_tokens, temperature=temperature,
-                               top_k=top_k, seed=seed + lo,
-                               eos_id=eos_id, token_chunk=token_chunk,
-                               prefill=prefill)
-                 for lo in range(0, b, n * mb)], axis=0)
+            # Streaming callers see each round's spans in turn; the
+            # rows kwarg identifies the round's sequence range.
+            outs = []
+            for lo in range(0, b, n * mb):
+                cb = None
+                if on_tokens is not None:
+                    def cb(a, c, t, rows, _lo=lo):  # noqa: E306
+                        on_tokens(a, c, t,
+                                  rows=(_lo + rows[0], _lo + rows[1]))
+                outs.append(self.generate(
+                    prompt_ids[lo: lo + n * mb], max_new_tokens,
+                    temperature=temperature, top_k=top_k, seed=seed + lo,
+                    eos_id=eos_id, token_chunk=token_chunk,
+                    prefill=prefill, on_tokens=cb))
+            return np.concatenate(outs, axis=0)
         t_tok = plen + max_new_tokens
         if t_tok > self.max_len:
             raise ValueError(
@@ -763,33 +785,48 @@ class PipelinedDecoder:
                              else np.zeros((n, mb), np.int32))
         fp_s = jnp.int32(plen if prefill else -1)
         start_s = jnp.int32(start)
-        chunks: list = []  # device chunks (no-eos path), drained at the end
+        chunks: list = []  # device chunks (batch path), drained at the end
         out3, p0 = self._gather_init(prompt, plen, t_tok, start,
                                      first_ids_np)
+        incremental = eos_id is not None or on_tokens is not None
+        p_done = plen - 1  # last position already delivered to on_tokens
+        if on_tokens is not None and prefill and t_tok > plen:
+            # the prefill already produced position plen (first_ids)
+            flat = out3.reshape(n * mb, t_tok)[:b]
+            on_tokens(plen, plen + 1, flat[:, plen: plen + 1].copy(),
+                      rows=(0, b))
+            p_done = plen
         steps_run = 0
         while steps_run < num_steps:
             a, caches, ids = fn(self._w, prompt_dev, plen_s,
                                 jnp.int32(steps_run), jnp.int32(num_steps),
                                 seed_s, temp_s, fi_dev, fp_s, start_s,
                                 a, caches)
-            if eos_id is not None:
+            if incremental:
                 # incremental scatter of just this chunk: linear host work
                 self._gather_into(out3, np.asarray(ids[0]), steps_run,
                                   t_tok, start, p0)
             else:
                 chunks.append(ids)
             steps_run += chunk_steps
-            if eos_id is not None:
+            if incremental:
                 # positions already decodable for EVERY group this far
                 p_avail = start + min(
                     (steps_run - 1 - (n - 1) - g) // n + 1
                     for g in range(n))
                 p_avail = min(p_avail, t_tok - 1)
                 flat = out3.reshape(n * mb, t_tok)[:b]
-                if p_avail >= plen and np.all(
+                if on_tokens is not None and p_avail > p_done \
+                        and p_avail >= plen:
+                    lo = max(p_done + 1, plen)
+                    on_tokens(lo, p_avail + 1,
+                              flat[:, lo: p_avail + 1].copy(),
+                              rows=(0, b))
+                    p_done = p_avail
+                if eos_id is not None and p_avail >= plen and np.all(
                         (flat[:, plen: p_avail + 1] == eos_id).any(axis=1)):
                     break
-        for i, c in enumerate(chunks):  # no-eos path: one pass at the end
+        for i, c in enumerate(chunks):  # non-incremental: one pass at the end
             self._gather_into(out3, np.asarray(c[0]), i * chunk_steps,
                               t_tok, start, p0)
         out = out3.reshape(n * mb, t_tok)[:b]

@@ -401,6 +401,74 @@ def test_beam_one_equals_greedy(model, prompt):
                                   beam1.generate(prompt, 6))
 
 
+def test_token_streaming_callback(model, prompt):
+    """on_tokens delivers contiguous, non-overlapping spans that concat to
+    exactly the generated region — with chunking and with prefill."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=4, microbatch=2,
+                           max_len=MAX_LEN)
+    for kw in (dict(token_chunk=2), dict(token_chunk=3, prefill=True)):
+        spans = []
+        out = dec.generate(prompt, 9, on_tokens=lambda lo, hi, t, rows:
+                           spans.append((lo, hi, t, rows)), **kw)
+        los = [s[0] for s in spans]
+        his = [s[1] for s in spans]
+        assert los[0] == 5 and his[-1] == 14
+        assert all(h == l for h, l in zip(his[:-1], los[1:]))  # contiguous
+        assert all(s[3] == (0, 8) for s in spans)
+        streamed = np.concatenate([s[2] for s in spans], axis=1)
+        np.testing.assert_array_equal(streamed, out[:, 5:])
+
+
+def test_streaming_multi_round(model, prompt):
+    """B beyond one pipeline fill: spans arrive per round with the round's
+    row range, and together cover every sequence."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=2,
+                           max_len=MAX_LEN)  # capacity 4 < B=8
+    spans = []
+    out = dec.generate(prompt, 6, token_chunk=2,
+                       on_tokens=lambda lo, hi, t, rows:
+                       spans.append((lo, hi, t, rows)))
+    row_ranges = {s[3] for s in spans}
+    assert row_ranges == {(0, 4), (4, 8)}
+    for r0, r1 in sorted(row_ranges):
+        streamed = np.concatenate(
+            [s[2] for s in spans if s[3] == (r0, r1)], axis=1)
+        np.testing.assert_array_equal(streamed, out[r0:r1, 5:])
+
+
+def test_streaming_with_eos(model, prompt):
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                           max_len=MAX_LEN)
+    ref = dec.generate(prompt, 10)
+    eos = int(ref[0, 6])
+    spans = []
+    out = dec.generate(prompt, 10, eos_id=eos, token_chunk=2,
+                       on_tokens=lambda lo, hi, t, rows:
+                       spans.append((lo, hi)))
+    assert spans and spans[0][0] == 5
+    gen = out[0, 5:]
+    hits = np.where(gen == eos)[0]
+    assert hits.size and (gen[hits[0]:] == eos).all()
+
+
+def test_beam_with_int8_cache(model, prompt):
+    """Beam re-parenting gathers the int8 cache AND its scale entries."""
+    graph, params = model
+    exact = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                             max_len=MAX_LEN, beam_width=2)
+    quant = PipelinedDecoder(graph, params, num_stages=2, microbatch=4,
+                             max_len=MAX_LEN, beam_width=2,
+                             kv_cache="int8")
+    a = exact.generate(prompt[:4], 7)
+    b = quant.generate(prompt[:4], 7)
+    assert a.shape == b.shape and (b[:, :5] == prompt[:4]).all()
+    assert (a == b).mean() > 0.85, (a, b)
+    np.testing.assert_array_equal(b, quant.generate(prompt[:4], 7))
+
+
 def test_beam_validation(model, prompt):
     graph, params = model
     with pytest.raises(ValueError, match="divide"):
