@@ -27,10 +27,15 @@ harness re-feeding one image (test/test.py:20-23).
 Device handling: this environment reaches its single TPU chip through a
 tunnel that admits one client and can wedge indefinitely if a previous
 client died holding the grant.  The TPU is therefore probed in a THROWAWAY
-SUBPROCESS (bounded by a timeout) with retries and backoff; only after a
-probe succeeds does this process initialize the backend.  Set
-``DEFER_BENCH_REQUIRE_TPU=1`` to exit(3) instead of falling back to an
-8-virtual-device CPU mesh (same code path, tiny model).
+SUBPROCESS under a HARD-CAPPED total budget (default 2 probes x 150 s +
+15 s backoff, ~5.5 min worst case — env DEFER_BENCH_TPU_TIMEOUT_S /
+_ATTEMPTS / _BACKOFF_S).  If no TPU materialises in budget, the bench
+prints a parseable ``{"value": null, "tpu_unavailable": true, "last_good":
+...}`` line and exits 0 — it must NEVER outlive the driver's capture
+window (BENCH_r02/r04 were rc=124/no-output under the old unbounded
+retry policy).  Set ``DEFER_BENCH_REQUIRE_TPU=1`` to exit(3) instead;
+set ``DEFER_BENCH_CPU=1`` to run the CPU smoke path (tiny model)
+explicitly.
 
 Prints exactly one JSON line on stdout:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., extras}
@@ -66,7 +71,7 @@ def probe_tpu_subprocess(timeout_s: float) -> tuple[str | None, str]:
     or is killed at the timeout — leaving THIS process clean either way
     (an in-process hung init can never be unwound).
     """
-    code = (
+    code = os.environ.get("DEFER_BENCH_PROBE_CODE") or (
         "import jax; ds = jax.devices(); "
         "print(ds[0].platform, '|', getattr(ds[0], 'device_kind', ''), "
         "'|', len(ds))"
@@ -89,6 +94,13 @@ def probe_tpu_subprocess(timeout_s: float) -> tuple[str | None, str]:
 def init_devices():
     """``jax.devices()`` behind a subprocess probe with retries/backoff."""
     if os.environ.get("DEFER_BENCH_CPU") == "1":
+        # explicit CPU smoke run: 8 virtual devices, tiny model
+        if "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8")
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""  # skip TPU plugin entirely
         import jax
         jax.config.update("jax_platforms", "cpu")
         return jax.devices()
@@ -97,24 +109,37 @@ def init_devices():
     # leaving a dead client on the single-client tunnel if the probe had
     # already acquired the device grant (it normally hangs *waiting* for
     # it).  There is no graceful way to unwind a C++-level hang, and not
-    # probing at all means no TPU number ever; so probe with a generous
-    # timeout that comfortably covers a healthy (if slow) init.
-    attempts = int(os.environ.get("DEFER_BENCH_TPU_ATTEMPTS", "3"))
-    timeout_s = float(os.environ.get("DEFER_BENCH_TPU_TIMEOUT_S", "600"))
+    # probing at all means no TPU number ever.  The TOTAL probe budget is
+    # hard-capped (default 2 x 150 s + 15 s backoff ~ 5.5 min) so a wedged
+    # tunnel yields a fast, parseable "tpu unavailable" JSON line instead
+    # of outliving the driver's capture window (BENCH_r02/r04 were rc=124
+    # for exactly that reason).
+    attempts = int(os.environ.get("DEFER_BENCH_TPU_ATTEMPTS", "2"))
+    timeout_s = float(os.environ.get("DEFER_BENCH_TPU_TIMEOUT_S", "150"))
+    backoff_s = float(os.environ.get("DEFER_BENCH_TPU_BACKOFF_S", "15"))
     require = os.environ.get("DEFER_BENCH_REQUIRE_TPU") == "1"
+    deadline = time.monotonic() + attempts * timeout_s + (attempts - 1) * \
+        backoff_s + 30.0  # absolute ceiling, belt over the per-probe caps
 
     ok = False
+    diag = "no probe attempted"
     for i in range(attempts):
-        info, diag = probe_tpu_subprocess(timeout_s)
+        budget = min(timeout_s, deadline - time.monotonic())
+        if budget <= 0:
+            diag = "total probe budget exhausted"
+            break
+        info, diag = probe_tpu_subprocess(budget)
         log(f"bench: tpu probe {i + 1}/{attempts}: {diag}"
             + (f" -> {info}" if info else ""))
-        if info is not None:
+        if info is not None and not info.startswith("cpu"):
             ok = True
             break
-        if i + 1 < attempts:
-            backoff = 30.0 * (i + 1)
-            log(f"bench: backing off {backoff:.0f}s before retry")
-            time.sleep(backoff)
+        if info is not None:  # probe came back, but only a CPU backend
+            diag = f"probe found no TPU (backend: {info})"
+            break
+        if i + 1 < attempts and time.monotonic() + backoff_s < deadline:
+            log(f"bench: backing off {backoff_s:.0f}s before retry")
+            time.sleep(backoff_s)
 
     if ok:
         # the probe released the grant cleanly; init here should be fast —
@@ -130,25 +155,62 @@ def init_devices():
 
         th = threading.Thread(target=_init, daemon=True)
         th.start()
-        th.join(timeout_s)
+        th.join(max(5.0, min(timeout_s, deadline - time.monotonic())))
         if "devices" in box:
             return box["devices"]
-        log(f"bench: in-process init failed after successful probe "
-            f"({box.get('error', 'timed out')})")
+        diag = (f"in-process init failed after successful probe "
+                f"({box.get('error', 'timed out')})")
+        log(f"bench: {diag}")
 
     if require:
         log("bench: DEFER_BENCH_REQUIRE_TPU=1 and no TPU; exiting 3")
         sys.exit(3)
-    log("bench: falling back to 8-virtual-device CPU mesh (tiny model); "
-        "this is NOT a TPU result")
-    env = dict(os.environ)
-    env["DEFER_BENCH_CPU"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""  # skip TPU plugin registration entirely
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8")
-    os.execve(sys.executable,
-              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
+    emit_unavailable_and_exit(diag)
+
+
+def emit_unavailable_and_exit(diag: str):
+    """No TPU within budget: print ONE parseable JSON line and exit 0.
+
+    The driver's scoreboard parses stdout for a single JSON object; a
+    wedged tunnel must degrade to this line (with the last known-good TPU
+    number attached for context), never to rc=124 with no output.
+    """
+    last_good = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("BENCH_r04_builder.json", "BENCH_r03.json"):
+        try:
+            with open(os.path.join(here, name)) as f:
+                prev = json.load(f)
+            if prev.get("value") is None:  # wrapper records carry no value
+                continue
+            last_good = {
+                "artifact": name,
+                "metric": prev.get("metric"),
+                "value": prev.get("value"),
+                "unit": prev.get("unit"),
+                "vs_baseline": prev.get("vs_baseline"),
+                "mfu_best": prev.get("mfu_best"),
+            }
+            break
+        except Exception:  # noqa: BLE001 — artifact optional
+            continue
+    # metric name must match the real series (stage count varies with the
+    # environment's device count) — reuse the last good run's name if any
+    metric = (last_good or {}).get("metric") or "resnet50_pipeline_throughput"
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": "inferences/sec",
+        "vs_baseline": None,
+        "tpu_unavailable": True,
+        "probe_diag": diag,
+        "last_good": last_good,
+    }))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # _exit, not sys.exit: a partially-initialized XLA runtime (hung init
+    # thread) can block interpreter finalization — the rc=124 mode again
+    os._exit(0)
 
 
 def main():

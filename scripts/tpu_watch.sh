@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll the TPU tunnel; on success touch /tmp/tpu_ok and exit.
+# Probe timeout is GENEROUS (300 s) and attempts are bounded: killing a
+# probe that has just acquired the device grant can itself wedge the
+# single-client tunnel, so err toward waiting, probe rarely, stop after
+# ~6 h rather than looping forever.
+for i in $(seq 1 36); do
+  if timeout 300 python -c "import jax; ds=jax.devices(); assert ds[0].platform!='cpu'; print(ds[0].device_kind)" >/tmp/tpu_kind 2>/tmp/tpu_err; then
+    date +%s > /tmp/tpu_ok
+    echo "tpu healthy after probe $i: $(cat /tmp/tpu_kind)"
+    exit 0
+  fi
+  echo "probe $i failed $(date -u +%H:%M:%S)"
+  sleep 300
+done
+echo "gave up after 36 probes"
+exit 1
