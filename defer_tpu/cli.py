@@ -137,8 +137,9 @@ def cmd_node(args):
 
     node = StageNode(args.artifact, args.listen, args.next,
                      codec=args.codec)
-    print(f"node: stage {node.manifest['index']} "
-          f"({node.manifest['name']}) listening on "
+    what = (f"stage {node.manifest['index']} ({node.manifest['name']})"
+            if node.manifest else "EMPTY (awaiting in-band deploy)")
+    print(f"node: {what} listening on "
           f"{node.address[0]}:{node.address[1]}, next {args.next}",
           file=sys.stderr, flush=True)
     n = node.serve(connect_timeout_s=args.connect_timeout)
@@ -161,7 +162,8 @@ def cmd_chain(args):
           .astype(np.float32) for _ in range(args.count)]
 
     t0 = time.perf_counter()
-    outs = run_chain(stages, params, xs, batch=args.batch, codec=args.codec)
+    outs = run_chain(stages, params, xs, batch=args.batch, codec=args.codec,
+                     in_band=args.in_band)
     dt = time.perf_counter() - t0
 
     fwd = jax.jit(graph.apply)
@@ -295,11 +297,13 @@ def main(argv=None):
     e.add_argument("--batch", type=int, default=1)
 
     nd = sub.add_parser("node", help="run one standalone stage node")
-    nd.add_argument("--artifact", required=True)
+    nd.add_argument("--artifact", default=None,
+                    help="pre-placed stage artifact; omit to boot empty "
+                         "and receive it in-band (control handshake)")
     nd.add_argument("--listen", required=True, metavar="[host]:port")
-    nd.add_argument("--next", required=True, metavar="host:port",
+    nd.add_argument("--next", default=None, metavar="host:port",
                     help="successor hop (last node: the dispatcher's "
-                         "result port)")
+                         "result port); omit to receive it in-band")
     nd.add_argument("--codec", default="raw",
                     choices=["raw", "lzb", "bf8", "bf12", "bf16"])
     nd.add_argument("--connect-timeout", type=float, default=30.0)
@@ -313,6 +317,9 @@ def main(argv=None):
     c.add_argument("--count", type=int, default=8)
     c.add_argument("--codec", default="raw",
                    choices=["raw", "lzb", "bf8", "bf12", "bf16"])
+    c.add_argument("--in-band", action="store_true",
+                   help="boot nodes empty; ship artifacts over the "
+                        "control handshake")
 
     t = sub.add_parser("train", help="pipeline-parallel training demo "
                                      "(synthetic data, cross-entropy)")

@@ -36,8 +36,9 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from ..transport.framed import (K_END, K_TENSOR, recv_frame, send_end,
-                                send_frame)
+from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
+                                recv_expect, recv_frame, send_ack,
+                                send_ctrl, send_end, send_frame)
 
 
 def _connect_retry(host: str, port: int, timeout_s: float = 30.0
@@ -65,54 +66,160 @@ def _parse_hostport(s: str, default_host: str = "127.0.0.1"
 class StageNode:
     """One compute node of a process chain: recv -> stage fn -> relay.
 
-    ``python -m defer_tpu node --artifact stage_k.zip --listen :5000
-    --next host:5000`` is the working equivalent of the reference's
-    ``python node.py`` (src/node.py:126-127).
+    ``python -m defer_tpu node --listen :5000`` boots an EMPTY node that
+    receives its stage artifact in-band over the control handshake —
+    completing parity with the reference node, which also boots with
+    nothing and gets its model over the wire (src/node.py:20-55).
+    ``--artifact stage_k.zip --next host:5000`` pre-loads from a local
+    file instead (the r3/r4 behavior, kept for pre-provisioned hosts).
     """
 
-    def __init__(self, artifact: str, listen: str, next_hop: str,
-                 *, codec: str = "raw"):
-        from ..utils.export import load_stage
+    def __init__(self, artifact: str | None, listen: str,
+                 next_hop: str | None, *, codec: str = "raw"):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
         # process exists
         host, port = _parse_hostport(listen, "0.0.0.0")
         self._srv = socket.create_server((host, port))
         self.address = self._srv.getsockname()
-        self.fn, self.manifest = load_stage(artifact)
-        self.next_hop = _parse_hostport(next_hop)
+        self.prog = None
+        if artifact is not None:
+            from ..utils.export import load_stage_program
+            self.prog = load_stage_program(artifact)
+        self.next_hop = _parse_hostport(next_hop) if next_hop else None
         self.codec = codec
 
-    def serve(self, *, connect_timeout_s: float = 30.0) -> int:
-        """Accept one upstream connection and relay until its END frame.
+    @property
+    def manifest(self):
+        return None if self.prog is None else self.prog.manifest
 
-        Returns the number of tensors processed.  The END frame is
-        forwarded downstream before closing, so shutdown cascades through
-        the chain to the dispatcher's result server.
+    def _handle_ctrl(self, conn, msg: dict) -> bool:
+        """One control command; True if the connection should keep serving.
+
+        deploy:   {"cmd": "deploy", "next": "host:port", "codec": ...}
+                  followed by a K_BYTES artifact blob -> load, ACK.
+                  The in-band analogue of the reference's weights+arch
+                  sockets and \\x06 ACK (src/dispatcher.py:44-65).
+        reweight: {"cmd": "reweight"} followed by a K_BYTES npz blob ->
+                  swap weights in the already-loaded program, ACK
+                  (redeploy without restart; no reference analogue).
         """
-        conn, _ = self._srv.accept()
-        out = _connect_retry(*self.next_hop, timeout_s=connect_timeout_s)
+        from ..utils.export import load_stage_program
+        cmd = msg.get("cmd")
+        if cmd == "deploy":
+            blob = recv_expect(conn, K_BYTES)
+            self.prog = load_stage_program(blob)
+            if msg.get("next"):
+                self.next_hop = _parse_hostport(msg["next"])
+            if msg.get("codec"):
+                self.codec = msg["codec"]
+            send_ack(conn)
+            return True
+        if cmd == "reweight":
+            if self.prog is None:
+                raise ValueError("reweight before deploy")
+            self.prog.reweight(recv_expect(conn, K_BYTES))
+            send_ack(conn)
+            return True
+        raise ValueError(f"unknown control command {msg!r}")
+
+    def serve(self, *, connect_timeout_s: float = 30.0) -> int:
+        """Serve control/data connections until a data stream completes.
+
+        Connections are handled CONCURRENTLY (thread per connection — the
+        shape of the reference node's 4-thread design, src/node.py:110-124,
+        minus the polling): control connections (deploy / reweight, each
+        ACKed, ending with the dispatcher's END) may arrive before or
+        *during* the upstream data stream, which is relayed through the
+        stage function until its END frame.  Returns the number of tensors
+        the completed data stream processed.  The END is forwarded
+        downstream before closing, so shutdown cascades through the chain
+        to the dispatcher's result server.
+        """
+        import queue as _q
+        import threading
+
+        done: _q.Queue = _q.Queue()
+
+        def worker(conn):
+            try:
+                n = self._serve_conn(conn, connect_timeout_s)
+                if n is not None:
+                    done.put(n)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                done.put(e)
+            finally:
+                conn.close()
+
+        self._srv.settimeout(0.25)
+        try:
+            while True:
+                try:
+                    conn, _ = self._srv.accept()
+                except TimeoutError:  # socket.timeout is TimeoutError >=3.10
+                    conn = None
+                if conn is not None:
+                    threading.Thread(target=worker, args=(conn,),
+                                     daemon=True).start()
+                try:
+                    r = done.get_nowait()
+                except _q.Empty:
+                    continue
+                if isinstance(r, BaseException):
+                    raise r
+                return r
+        finally:
+            self._srv.close()
+
+    def _serve_conn(self, conn, connect_timeout_s: float) -> int | None:
+        """One connection: None if it was control-only, else tensor count."""
+        out = None
         n = 0
-        want = tuple(self.manifest["in_shape"])
+        streamed = False
         try:
             while True:
                 kind, value = recv_frame(conn)
                 if kind == K_END:
-                    send_end(out)
-                    return n
+                    if streamed:
+                        send_end(out)
+                        return n
+                    return None  # control connection closing
+                if kind == K_CTRL:
+                    self._handle_ctrl(conn, value)
+                    continue
                 if kind != K_TENSOR:
                     raise ValueError(f"unexpected frame kind {kind}")
+                if self.prog is None:
+                    raise ValueError(
+                        "data frame before any stage artifact (boot with "
+                        "--artifact or deploy in-band first)")
+                if out is None:
+                    if self.next_hop is None:
+                        raise ValueError("no next hop configured")
+                    out = _connect_retry(*self.next_hop,
+                                         timeout_s=connect_timeout_s)
+                want = tuple(self.manifest["in_shape"])
                 if tuple(value.shape[1:]) != want:
                     raise ValueError(
                         f"stage {self.manifest['index']} expects sample "
                         f"shape {want}, got {tuple(value.shape[1:])}")
-                y = np.asarray(self.fn(value))
+                y = np.asarray(self.prog(value))
                 send_frame(out, y, codec=self.codec)
                 n += 1
+                streamed = True
+        except Exception as e:  # noqa: BLE001 — see below
+            if streamed:
+                raise  # upstream died / corrupted mid-stream: loud
+            # a connection that never became the data stream must not be
+            # able to kill a serving node: port scanners and malformed
+            # control peers are logged and dropped.  The remote side still
+            # fails loudly — its recv gets a cut connection, no ACK/END.
+            print(f"node: dropped connection before streaming: {e!r}",
+                  file=sys.stderr, flush=True)
+            return None
         finally:
-            out.close()
-            conn.close()
-            self._srv.close()
+            if out is not None:
+                out.close()
 
 
 class ChainDispatcher:
@@ -143,9 +250,9 @@ class ChainDispatcher:
             # generous: every node in the chain cold-imports jax first
             self._send_sock = _connect_retry(
                 *_parse_hostport(self.first_hop), timeout_s=self.timeout_s)
-        if self._res_conn is None:
-            self._res_conn, _ = self._res_srv.accept()
-            self._res_conn.settimeout(self.timeout_s)
+        # the result connection is accepted lazily in _recv_tensor: the
+        # last node only dials back once its first tensor arrives, so
+        # accepting before sending anything would deadlock the chain
 
     def stream(self, inputs) -> list[np.ndarray]:
         """Send every input through the chain; return outputs in order."""
@@ -163,10 +270,67 @@ class ChainDispatcher:
             in_flight -= 1
         return outs
 
+    def deploy(self, stages, params, node_addrs: Sequence[str], *,
+               batch: int = 1, result_hop: str | None = None):
+        """Ship each stage's artifact to its node over the control channel.
+
+        Serial, in chain order, each ACKed before the next — the in-band
+        model distribution of the reference dispatcher
+        (src/dispatcher.py:44-65: weights, arch JSON, next-node IP, \\x06
+        ACK) collapsed to one control connection per node carrying a
+        self-contained StableHLO+weights blob.  Nodes may boot with no
+        pre-placed files at all.  ``result_hop`` overrides the address the
+        last node relays results to (defaults to this dispatcher's result
+        server, reference src/dispatcher.py:51-55).
+        """
+        from ..utils.export import export_stage_bytes
+        addrs = list(node_addrs)
+        if len(addrs) != len(stages):
+            raise ValueError(f"{len(stages)} stages but {len(addrs)} nodes")
+        result_hop = result_hop or \
+            f"{self.result_address[0]}:{self.result_address[1]}"
+        for i, (stage, addr) in enumerate(zip(stages, addrs)):
+            nxt = addrs[i + 1] if i + 1 < len(addrs) else result_hop
+            blob = export_stage_bytes(stage, params, batch=batch)
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=self.timeout_s)
+            try:
+                send_ctrl(s, {"cmd": "deploy", "next": nxt,
+                              "codec": self.codec})
+                send_frame(s, blob)
+                recv_expect(s, K_ACK)
+                send_end(s)
+            finally:
+                s.close()
+
+    def reweight(self, stages, params, node_addrs: Sequence[str]):
+        """Weights-only re-push: install fresh weights on every node's
+        already-loaded stage program — redeploy (e.g. after more training)
+        without restarting any process or resending StableHLO."""
+        from ..utils.export import stage_weight_leaves, weights_blob
+        node_addrs = list(node_addrs)
+        if len(node_addrs) != len(stages):
+            raise ValueError(
+                f"{len(stages)} stages but {len(node_addrs)} nodes")
+        for stage, addr in zip(stages, node_addrs):
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=self.timeout_s)
+            try:
+                send_ctrl(s, {"cmd": "reweight"})
+                send_frame(s, weights_blob(
+                    stage_weight_leaves(stage, params)))
+                recv_expect(s, K_ACK)
+                send_end(s)
+            finally:
+                s.close()
+
     def _recv_tensor(self) -> np.ndarray:
         """One in-order result frame; loud protocol check (not an assert:
         ``python -O`` strips asserts, and an early END from a node that died
         mid-stream must raise, not silently mis-drain)."""
+        if self._res_conn is None:
+            self._res_conn, _ = self._res_srv.accept()
+            self._res_conn.settimeout(self.timeout_s)
         kind, y = recv_frame(self._res_conn)
         if kind != K_TENSOR:
             raise ConnectionError(
@@ -183,6 +347,15 @@ class ChainDispatcher:
         try:
             if self._send_sock is not None:
                 send_end(self._send_sock)
+                if self._res_conn is None:
+                    # nothing was ever received: still accept the last
+                    # node's dial-back so its cascaded END completes
+                    try:
+                        self._res_srv.settimeout(min(10.0, self.timeout_s))
+                        self._res_conn, _ = self._res_srv.accept()
+                        self._res_conn.settimeout(self.timeout_s)
+                    except OSError:
+                        pass
                 if self._res_conn is not None:
                     # drain any leftover in-flight frames until the END
                     # cascades through
@@ -211,13 +384,20 @@ def _free_ports(n: int) -> list[int]:
 def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               *, batch: int = 1, codec: str = "raw",
               artifact_dir: str | None = None,
-              env: dict[str, str] | None = None) -> list[np.ndarray]:
+              env: dict[str, str] | None = None,
+              in_band: bool = False) -> list[np.ndarray]:
     """Export, spawn one OS process per stage, stream, and tear down.
 
     The one-call analogue of the reference's whole deployment procedure
     (start N ``node.py`` processes, run the dispatcher, src/dispatcher.py:
     44-65 + test/test.py) — used by the CLI ``chain`` command and the
     multi-process integration test.
+
+    ``in_band=True`` boots every node EMPTY (no --artifact flag, no shared
+    filesystem) and ships each stage artifact over its control connection
+    with an ACK handshake — full control-plane parity with the reference.
+    ``in_band=False`` pre-exports artifacts to a (shared) directory and
+    passes paths on the command line.
 
     ``env`` overrides the child environment.  By default children are
     pinned to the CPU backend: a local chain is a topology demonstration,
@@ -232,9 +412,9 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     if artifact_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="defer_chain_")
         artifact_dir = tmp.name
+    logs: list = []
     try:
-        paths = export_pipeline(stages, params, artifact_dir, batch=batch)
-        n = len(paths)
+        n = len(stages)
         ports = _free_ports(n + 1)  # node listen ports + result port
         result_port = ports[-1]
 
@@ -244,24 +424,39 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
         child_env.update(env)
 
-        procs, logs = [], []
-        for i, p in enumerate(paths):
-            nxt = (f"127.0.0.1:{ports[i + 1]}" if i + 1 < n
-                   else f"127.0.0.1:{result_port}")
+        if in_band:
+            argv_for = lambda i: [  # noqa: E731 — tiny per-node argv
+                sys.executable, "-m", "defer_tpu", "node",
+                "--listen", f"127.0.0.1:{ports[i]}"]
+        else:
+            paths = export_pipeline(stages, params, artifact_dir,
+                                    batch=batch)
+            argv_for = lambda i: [  # noqa: E731
+                sys.executable, "-m", "defer_tpu", "node",
+                "--artifact", paths[i],
+                "--listen", f"127.0.0.1:{ports[i]}",
+                "--next", (f"127.0.0.1:{ports[i + 1]}" if i + 1 < n
+                           else f"127.0.0.1:{result_port}"),
+                "--codec", codec]
+
+        procs = []
+        for i in range(n):
             # log to files, not PIPEs: an undrained pipe fills and
             # deadlocks a chatty child mid-chain
             lf = open(os.path.join(artifact_dir, f"node_{i}.log"), "w+")
             logs.append(lf)
             procs.append(subprocess.Popen(
-                [sys.executable, "-m", "defer_tpu", "node",
-                 "--artifact", p, "--listen", f"127.0.0.1:{ports[i]}",
-                 "--next", nxt, "--codec", codec],
-                env=child_env, stdout=lf, stderr=subprocess.STDOUT))
+                argv_for(i), env=child_env, stdout=lf,
+                stderr=subprocess.STDOUT))
 
         disp = ChainDispatcher(f"127.0.0.1:{ports[0]}",
                                listen=f"127.0.0.1:{result_port}",
                                codec=codec)
         try:
+            if in_band:
+                disp.deploy(stages, params,
+                            [f"127.0.0.1:{p}" for p in ports[:-1]],
+                            batch=batch)
             outs = disp.stream(inputs)
         finally:
             disp.close()
@@ -278,7 +473,7 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                     f"{logs[i].read()[-2000:]}")
         return outs
     finally:
-        for lf in locals().get("logs", []):
+        for lf in logs:
             lf.close()
         if tmp is not None:
             tmp.cleanup()

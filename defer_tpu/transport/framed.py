@@ -32,6 +32,8 @@ from ..codec import BlockFloatCodec, Codec, LosslessCodec, PipelineCodec, RawCod
 K_TENSOR = 1
 K_BYTES = 2
 K_END = 3
+K_CTRL = 4   # JSON control message (deploy/reweight handshake)
+K_ACK = 5    # the reference's 1-byte \x06 ACK (src/node.py:42), framed
 
 _CODECS: dict[str, Codec] = {}
 
@@ -78,6 +80,27 @@ def send_end(sock: socket.socket):
     sock.sendall(_HDR.pack(K_END, 0, 0, 0, 0))
 
 
+def send_ctrl(sock: socket.socket, msg: dict):
+    """Send one JSON control frame (the control-plane channel: deploy,
+    reweight — reference src/dispatcher.py:58-63's arch+topology send)."""
+    import json as _json
+    payload = _json.dumps(msg).encode()
+    sock.sendall(_HDR.pack(K_CTRL, 0, 0, 0, len(payload)) + payload)
+
+
+def send_ack(sock: socket.socket):
+    sock.sendall(_HDR.pack(K_ACK, 0, 0, 0, 0))
+
+
+def recv_expect(sock: socket.socket, kind: int) -> Any:
+    """Receive one frame and demand its kind — loud handshake errors."""
+    got, value = recv_frame(sock)
+    if got != kind:
+        raise ConnectionError(f"expected frame kind {kind}, got {got} "
+                              f"({value if got == K_CTRL else ''})")
+    return value
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -96,8 +119,13 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if kind == K_END:
         return K_END, None
+    if kind == K_ACK:
+        return K_ACK, None
     if plen > MAX_FRAME:
         raise ValueError(f"frame of {plen} bytes exceeds bound")
+    if kind == K_CTRL:
+        import json as _json
+        return K_CTRL, _json.loads(_recv_exact(sock, plen).decode())
     cname = _recv_exact(sock, clen).decode()
     if kind == K_BYTES:
         return K_BYTES, _recv_exact(sock, plen)

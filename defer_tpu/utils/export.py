@@ -32,14 +32,35 @@ _PROGRAM = "stage.stablehlo"
 _WEIGHTS = "weights.npz"
 
 
-def export_stage(stage: StageSpec, params: dict[str, Any], path: str,
-                 *, batch: int = 1) -> None:
-    """Serialize one pipeline stage to ``path`` (a zip archive).
+def stage_weight_leaves(stage: StageSpec,
+                        params: dict[str, Any]) -> list[np.ndarray]:
+    """The stage's weight pytree, flattened in the artifact's leaf order —
+    the unit both full export and weights-only re-push ship."""
+    leaves, _ = jax.tree.flatten(stage.select_params(params))
+    return [np.asarray(l) for l in leaves]
+
+
+def weights_blob(leaves: list[np.ndarray]) -> bytes:
+    """npz-serialize a leaf list (the reweight payload)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{f"w{i}": l for i, l in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def _load_weights_blob(data: bytes, num: int) -> list:
+    with np.load(io.BytesIO(data)) as npz:
+        return [jnp.asarray(npz[f"w{i}"]) for i in range(num)]
+
+
+def export_stage_bytes(stage: StageSpec, params: dict[str, Any],
+                       *, batch: int = 1) -> bytes:
+    """Serialize one pipeline stage to zip-archive bytes.
 
     Contents: portable StableHLO of the stage function specialized to
     ``batch``, the stage's weight pytree, and a JSON manifest with shapes
     and stage metadata (the analogue of the arch-JSON + weights pair the
-    reference ships per node).
+    reference ships per node, src/dispatcher.py:44-65) — a single blob so
+    the dispatcher can ship it over the control connection.
     """
     sp = stage.select_params(params)
     leaves, treedef = jax.tree.flatten(sp)
@@ -69,38 +90,79 @@ def export_stage(stage: StageSpec, params: dict[str, Any], path: str,
         "out_dtype": stage.out_spec.dtype.name,
         "num_weights": len(leaves),
     }
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+    out = io.BytesIO()
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(_MANIFEST, json.dumps(manifest, indent=1))
         z.writestr(_PROGRAM, blob)
-        buf = io.BytesIO()
-        np.savez(buf, **{f"w{i}": l for i, l in enumerate(leaves)})
-        z.writestr(_WEIGHTS, buf.getvalue())
+        z.writestr(_WEIGHTS, weights_blob(leaves))
+    return out.getvalue()
 
 
-def load_stage(path: str):
-    """Load an exported stage: returns ``(fn, manifest)``.
+def export_stage(stage: StageSpec, params: dict[str, Any], path: str,
+                 *, batch: int = 1) -> None:
+    """Serialize one pipeline stage to ``path`` (see export_stage_bytes)."""
+    data = export_stage_bytes(stage, params, batch=batch)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+class StageProgram:
+    """A loaded stage artifact: callable, with swappable weights.
 
     ``fn(x)`` runs the stage's StableHLO program with its shipped weights
     on the local backend — no model code required (the analogue of the
     node's ``model_from_json`` + ``set_weights``, reference
-    src/node.py:31-34).
+    src/node.py:31-34).  ``reweight(blob)`` installs a fresh weight set
+    (same shapes) without reloading the program — redeploy without
+    restart.
     """
-    with zipfile.ZipFile(path) as z:
+
+    def __init__(self, exported, leaves: list, manifest: dict):
+        self._exported = exported
+        self.manifest = manifest
+        self._install(leaves)
+
+    def _install(self, leaves: list):
+        if len(leaves) != self.manifest["num_weights"]:
+            raise ValueError(
+                f"expected {self.manifest['num_weights']} weight arrays, "
+                f"got {len(leaves)}")
+        call = self._exported.call
+        self._leaves = leaves
+        self.fn = jax.jit(lambda x: call(leaves, x))
+
+    def reweight(self, blob: bytes):
+        """Install a weights npz blob (shapes must match the artifact's)."""
+        new = _load_weights_blob(blob, self.manifest["num_weights"])
+        for i, (old, nw) in enumerate(zip(self._leaves, new)):
+            if old.shape != nw.shape or old.dtype != nw.dtype:
+                raise ValueError(
+                    f"weight {i}: artifact has {old.shape}/{old.dtype}, "
+                    f"re-push has {nw.shape}/{nw.dtype}")
+        self._install(new)
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
+def load_stage_program(src) -> StageProgram:
+    """Load an exported stage from a path or bytes into a StageProgram."""
+    f = io.BytesIO(src) if isinstance(src, (bytes, bytearray)) else src
+    with zipfile.ZipFile(f) as z:
         manifest = json.loads(z.read(_MANIFEST).decode())
         if manifest.get("format") != "defer_tpu.stage.v1":
-            raise ValueError(f"{path}: not a defer_tpu stage artifact")
+            raise ValueError(f"{src!r:.80}: not a defer_tpu stage artifact")
         exported = jax_export.deserialize(z.read(_PROGRAM))
-        with np.load(io.BytesIO(z.read(_WEIGHTS))) as npz:
-            leaves = [jnp.asarray(npz[f"w{i}"])
-                      for i in range(manifest["num_weights"])]
+        leaves = _load_weights_blob(z.read(_WEIGHTS),
+                                    manifest["num_weights"])
+    return StageProgram(exported, leaves, manifest)
 
-    call = exported.call
 
-    def fn(x):
-        return call(leaves, x)
-
-    return jax.jit(fn), manifest
+def load_stage(path: str):
+    """Back-compat loader: returns ``(fn, manifest)``."""
+    prog = load_stage_program(path)
+    return prog.fn, prog.manifest
 
 
 def export_pipeline(stages, params, directory: str, *, batch: int = 1):
