@@ -204,6 +204,35 @@ class LayerGraph:
                 break
         return cache[upto]
 
+    # -- derived graphs ----------------------------------------------------
+
+    def with_input_shape(self, shape: Sequence[int],
+                         dtype: Any = None) -> "LayerGraph":
+        """Same ops/params, re-inferred specs for a new input shape.
+
+        Ops must be shape-polymorphic in ``apply`` (true of the sequence
+        ops: embeddings slice ``wpe[:t]``, attention masks derive from the
+        runtime shape).  Parameters of the original graph remain valid —
+        ``init`` specs are constructor-determined, not input-determined.
+        Used by :meth:`Defer.score` to run short sequences through a
+        short-length pipeline instead of padding to the full graph length.
+        """
+        spec = ShapeSpec(shape, dtype or self.input_spec.dtype)
+        nodes: dict[str, LayerNode] = {}
+
+        def spec_of(n: str) -> ShapeSpec:
+            return spec if n == self.input_name else nodes[n].out_spec
+
+        for name, node in self.nodes.items():
+            in_specs = tuple(spec_of(i) for i in node.inputs)
+            batched = [s.batched(1) for s in in_specs]
+            out = jax.eval_shape(node.op.apply, node.param_spec, *batched)
+            nodes[name] = LayerNode(name, node.op, node.inputs,
+                                    ShapeSpec(out.shape[1:], out.dtype),
+                                    node.param_spec)
+        return LayerGraph(self.name, nodes, self.input_name,
+                          self.output_name, spec)
+
     def __repr__(self):
         return f"LayerGraph({self.name!r}, {len(self.nodes)} nodes)"
 

@@ -12,6 +12,7 @@ ICI/HBM-side (zero CPU-side tensor serialization, per BASELINE.md).
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -52,6 +53,18 @@ class DeferHandle:
         #: slowest completed dispatch (seconds) — scales the watchdog
         #: threshold so legitimately slow deployments never false-positive
         self._max_dispatch_s: float = 0.0
+        #: serve-thread generation: bumped by the watchdog on recovery so a
+        #: stale (wedged, later-unwedged) thread can never emit outputs
+        self._gen: int = 0
+        #: completed watchdog recoveries (rebuild + replay)
+        self.recoveries: int = 0
+        #: fed-but-not-yet-emitted real microbatch inputs, in feed order —
+        #: the bounded resubmit log a recovery generation replays
+        self._resubmit: collections.deque = collections.deque()
+        #: True once END_OF_STREAM was consumed from the input queue — a
+        #: recovery generation must not wait for a second END (the caller
+        #: already sent theirs); it replays, flushes, and exits
+        self._end_seen: bool = False
 
     def stop(self):
         self._stop.set()
@@ -97,6 +110,24 @@ class Defer:
     def __init__(self, mesh=None, config: DeferConfig | None = None):
         self.mesh = mesh
         self.config = config or DeferConfig()
+        # compiled-engine caches (decoder / score pipelines): repacking
+        # weights and re-jitting on every generate()/score() call costs
+        # tens of seconds on first dispatch (ADVICE r4).  Values keep the
+        # (graph, params) refs alive so the id()-keys can't be recycled.
+        # Caching contract: weight updates must produce a NEW params
+        # pytree (the JAX-functional norm — optimizer steps do); mutating
+        # leaves of a cached dict in place is NOT detected.
+        self._decoder_cache: dict[tuple, tuple] = {}
+        self._score_cache: dict[tuple, tuple] = {}
+        self._CACHE_MAX = 4
+
+    def _cfg_cache_key(self) -> tuple:
+        """Config fields that shape a compiled engine — part of every
+        engine-cache key so mutating self.config between calls rebuilds."""
+        c = self.config
+        return (c.microbatch, c.chunk, str(c.compute_dtype),
+                str(c.buffer_dtype), c.wire, c.mode, c.master_weights,
+                c.data_parallel, c.tensor_parallel)
 
     def _default_num_stages(self) -> int:
         """Stage count from this deployment's mesh (1 when mesh-less).
@@ -155,10 +186,19 @@ class Defer:
         from .decode import PipelinedDecoder
         if num_stages is None:
             num_stages = self._default_num_stages()
-        dec = PipelinedDecoder(
-            graph, params, num_stages=num_stages, mesh=self.mesh,
-            microbatch=self.config.microbatch, max_len=max_len,
-            compute_dtype=self.config.compute_dtype, kv_cache=kv_cache)
+        key = (id(graph), id(params), num_stages, max_len, kv_cache,
+               self._cfg_cache_key())
+        hit = self._decoder_cache.get(key)
+        if hit is not None and hit[0] is graph and hit[1] is params:
+            dec = hit[2]
+        else:
+            dec = PipelinedDecoder(
+                graph, params, num_stages=num_stages, mesh=self.mesh,
+                microbatch=self.config.microbatch, max_len=max_len,
+                compute_dtype=self.config.compute_dtype, kv_cache=kv_cache)
+            if len(self._decoder_cache) >= self._CACHE_MAX:
+                self._decoder_cache.pop(next(iter(self._decoder_cache)))
+            self._decoder_cache[key] = (graph, params, dec)
         return dec.generate(np.asarray(prompt_ids), max_new_tokens,
                             **sample_kw)
 
@@ -166,11 +206,17 @@ class Defer:
               num_stages: int | None = None):
         """Per-sequence log-likelihood of token ids under a causal LM.
 
-        ``ids``: [B, T] ints (B % microbatch == 0).  Runs the
-        full-sequence causal graph through the ordinary inference
-        pipeline and sums next-token log-probabilities.  Returns
-        ``(logprob [B], perplexity [B])`` — the evaluation-side companion
-        of :meth:`generate`.
+        ``ids``: [B, T] ints (B % microbatch == 0).  Runs the causal
+        graph through the ordinary inference pipeline and sums next-token
+        log-probabilities.  Returns ``(logprob [B], perplexity [B])`` —
+        the evaluation-side companion of :meth:`generate`.
+
+        Short sequences are routed through a LENGTH-BUCKETED pipeline:
+        the graph is re-specced (same ops, same params) at the next
+        power-of-two length >= T and jitted per bucket, so scoring 16
+        tokens under a 256-token graph pays 16-position attention, not
+        256 (causal masking makes the results bit-identical).  Bucketed
+        pipelines are cached on the instance.
         """
         ids = np.asarray(ids)
         if ids.ndim != 2:
@@ -182,18 +228,32 @@ class Defer:
                 f"B={b} must be a non-zero multiple of microbatch={mb}")
         if cut_points is None and num_stages is None:
             num_stages = self._default_num_stages()
-        pipe = self.build(graph, params, cut_points, num_stages)
-        t_model = pipe.in_spec.shape[0]
+        t_model = graph.input_spec.shape[0]
         if t > t_model:
             raise ValueError(
                 f"sequence length {t} exceeds the model's {t_model}")
+        bucket = max(8, 1 << (max(t, 1) - 1).bit_length())  # next pow2
+        bucket = min(bucket, t_model)
+        ckey = (id(graph), id(params), bucket, num_stages,
+                tuple(cut_points) if cut_points else None,
+                self._cfg_cache_key())
+        hit = self._score_cache.get(ckey)
+        if hit is not None and hit[0] is graph and hit[1] is params:
+            pipe = hit[2]
+        else:
+            g = graph if bucket == t_model else \
+                graph.with_input_shape((bucket,))
+            pipe = self.build(g, params, cut_points, num_stages)
+            if len(self._score_cache) >= self._CACHE_MAX:
+                self._score_cache.pop(next(iter(self._score_cache)))
+            self._score_cache[ckey] = (graph, params, pipe)
         # causal attention: right-padding cannot influence positions < t,
-        # so pad to the graph's fixed length and score the real prefix
-        padded = np.zeros((b, t_model), ids.dtype)
+        # so pad to the bucket length and score the real prefix
+        padded = np.zeros((b, bucket), ids.dtype)
         padded[:, :t] = ids
         logits = pipe.run(
-            padded.reshape(b // mb, mb, t_model).astype(np.float32))
-        logits = logits.reshape(b, t_model, -1)[:, :t]
+            padded.reshape(b // mb, mb, bucket).astype(np.float32))
+        logits = logits.reshape(b, bucket, -1)[:, :t]
         logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
         tgt = jnp.asarray(ids[:, 1:], jnp.int32)
         pick = jnp.take_along_axis(logp[:, :-1], tgt[..., None], -1)[..., 0]
@@ -267,18 +327,30 @@ class Defer:
     def serve_endpoint(self, graph, params, cut_points=None, *,
                        num_stages=None, host: str = "127.0.0.1",
                        port: int = 0, codec: str = "raw",
-                       stall_timeout_s: float = 120.0):
+                       stall_timeout_s: float = 120.0,
+                       max_clients: int = 1):
         """Network front door: accept framed tensors, stream them through
         the pipeline via the native staging ring, reply in order.
 
         This is the reference dispatcher's whole socket data plane
-        (src/dispatcher.py:85-105) as one endpoint: a reader thread pushes
-        incoming samples into the bounded native ring
-        (``transport/staging.py``); the serve loop drains whole chunk
-        blocks already laid out like the device transfer buffer and feeds
-        the SPMD engine; results flow back on the same connection.
-        Returns ``(server_address, thread)``; the thread exits after the
-        client's END frame has been fully drained and echoed.
+        (src/dispatcher.py:85-105) as one endpoint, grown past its
+        ``listen(1)`` (reference src/node.py:84-85): up to ``max_clients``
+        clients — concurrent or successive (reconnects after a client
+        death) — share ONE compiled pipeline.  Each client's reader thread
+        stages samples into the bounded native ring
+        (``transport/staging.py``) under a per-client in-flight window (so
+        one greedy client cannot starve the rest); sample provenance rides
+        a FIFO owners queue that mirrors ring order, and the serve loop
+        routes each emitted row back to its owner's connection — every
+        client sees exactly its own results, in its own send order.  A
+        client that dies mid-stream is discarded (its in-flight rows are
+        dropped on emergence) without disturbing the others.
+
+        Returns ``(server_address, thread)``; the thread exits once
+        ``max_clients`` connections have finished (END-drained and echoed,
+        or died) — or when ``thread.stop()`` is called (an operator
+        shutdown: stops accepting, drains in-flight rows, cuts any
+        still-connected clients without an END so they fail loudly).
         """
         import socket as _socket
 
@@ -292,21 +364,68 @@ class Defer:
         pipe.warmup()
         mb, buf = pipe.microbatch, pipe.buf_elems
         in_size = pipe.stages[0].in_spec.size
-        ring = HostStagingRing(mb * buf, n_slots=max(4 * pipe.chunk, 16))
+        n_slots = max(4 * pipe.chunk, 16)
+        ring = HostStagingRing(mb * buf, n_slots=n_slots)
         srv = _socket.create_server((host, port))
         address = srv.getsockname()
 
-        #: first error from either thread; a non-empty list aborts the
-        #: connection WITHOUT the END frame so the client fails loudly
-        #: (never a silently short result stream)
+        #: endpoint-fatal errors (pipeline death) PLUS per-client aborts;
+        #: a client whose stream errors is cut WITHOUT the END frame so it
+        #: fails loudly (never a silently short result stream)
         errors: list[BaseException] = []
 
-        def reader(conn):
+        class _Client:
+            __slots__ = ("conn", "lock", "state", "alive", "draining",
+                         "outstanding", "window")
+
+            def __init__(self, conn):
+                self.conn = conn
+                self.lock = threading.Lock()    # serializes writes
+                self.state = threading.Lock()   # guards the fields below
+                self.alive = True
+                self.draining = False
+                self.outstanding = 0
+                # fair-share cap on ring slots one client may occupy
+                self.window = threading.Semaphore(
+                    max(pipe.chunk, n_slots // (2 * max_clients)))
+
+        owners: collections.deque[_Client] = collections.deque()
+        push_lock = threading.Lock()  # makes (ring.push, owners.append) atomic
+        finished = threading.Semaphore(0)  # one release per finished client
+        clients: list[_Client] = []  # every accepted client, for teardown
+        stop_ev = threading.Event()  # operator shutdown (thread.stop())
+
+        def _finish(client: _Client, *, send_eos: bool):
+            """Exactly-once client teardown; END echo only on clean drain."""
+            with client.state:
+                if not client.alive:
+                    return
+                client.alive = False
+            try:
+                if send_eos:
+                    with client.lock:
+                        send_end(client.conn)
+            except OSError:
+                pass
+            client.conn.close()
+            finished.release()
+
+        def _maybe_drained(client: _Client):
+            with client.state:
+                done = (client.draining and client.outstanding == 0
+                        and client.alive)
+            if done:
+                _finish(client, send_eos=True)
+
+        def reader(client: _Client):
+            conn = client.conn
             try:
                 while True:
                     kind, value = recv_frame(conn)
                     if kind == K_END:
-                        ring.close()
+                        with client.state:
+                            client.draining = True
+                        _maybe_drained(client)
                         return
                     if kind != K_TENSOR:
                         raise ConnectionError(
@@ -322,46 +441,99 @@ class Defer:
                     else:
                         row = np.zeros((mb, buf), np.float32)
                         row[:, :in_size] = x
-                    # a full ring is normal backpressure (client ahead of
+                    if not client.window.acquire(timeout=stall_timeout_s):
+                        raise RuntimeError(
+                            f"client window full for {stall_timeout_s:.0f}s "
+                            f"— pipeline stalled; sample would be dropped")
+                    # a full ring is normal backpressure (clients ahead of
                     # the pipeline); a ring still full after the stall
                     # timeout means the pipeline stopped draining — fail
-                    # loudly, never silently drop the sample
-                    if not ring.push(row, timeout_s=stall_timeout_s):
-                        raise RuntimeError(
-                            f"staging ring full for {stall_timeout_s:.0f}s "
-                            f"— pipeline stalled; sample would be dropped")
-            except BaseException as e:  # noqa: BLE001 — any reader death
-                errors.append(e)        # must unwedge the serve loop
-                ring.close()
+                    # loudly, never silently drop the sample.  The owner
+                    # entry is registered BEFORE the push (a pushed sample
+                    # is instantly poppable — its owner must already be
+                    # queued) and retracted on failure; push_lock holds are
+                    # kept short (50 ms slices) so one backpressured client
+                    # never serializes the others for the whole stall
+                    # budget.
+                    deadline = time.monotonic() + stall_timeout_s
+                    while True:
+                        with push_lock:
+                            owners.append(client)
+                            with client.state:
+                                client.outstanding += 1
+                            ok = ring.push(row, timeout_s=0.05)
+                            if not ok:
+                                owners.pop()  # ours: appends are lock-held
+                                with client.state:
+                                    client.outstanding -= 1
+                        if ok:
+                            break
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"staging ring full for "
+                                f"{stall_timeout_s:.0f}s — pipeline "
+                                f"stalled; sample would be dropped")
+            except BaseException as e:  # noqa: BLE001 — client-fatal
+                errors.append(e)
+                _finish(client, send_eos=False)
+
+        def acceptor():
+            for _ in range(max_clients):
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return  # endpoint shut down
+                client = _Client(conn)
+                clients.append(client)
+                threading.Thread(target=reader, args=(client,),
+                                 daemon=True,
+                                 name="defer-endpoint-reader").start()
+
+        def _deliver(row: np.ndarray, out_shape):
+            client = owners.popleft()
+            with client.state:
+                client.outstanding -= 1
+                alive = client.alive
+            client.window.release()
+            if alive:
+                try:
+                    with client.lock:
+                        send_frame(client.conn, row.reshape(out_shape),
+                                   codec=codec)
+                except OSError as e:
+                    errors.append(e)
+                    _finish(client, send_eos=False)
+                else:
+                    _maybe_drained(client)
 
         def serve():
-            conn, _ = srv.accept()
-            conn_lock = threading.Lock()
-            threading.Thread(target=reader, args=(conn,), daemon=True,
-                             name="defer-endpoint-reader").start()
+            threading.Thread(target=acceptor, daemon=True,
+                             name="defer-endpoint-accept").start()
             pipe.reset()
+            out_shape = (mb,) + pipe.out_spec.shape
+            done_clients = 0
             try:
-                while True:
+                while done_clients < max_clients or owners:
+                    if stop_ev.is_set() and not owners:
+                        return  # operator stop: in-flight rows drained
+                    while finished.acquire(blocking=False):
+                        done_clients += 1
                     try:
                         got, block = ring.pop_block(pipe.chunk,
-                                                    timeout_s=1.0)
+                                                    timeout_s=0.25)
                     except TimeoutError:
-                        if errors:
-                            return  # reader died; abort without END
-                        continue
-                    if block is None:  # END (or reader error): drain
-                        if errors:
-                            return  # abort: reset-close, no END frame
-                        for o in pipe.flush():
-                            with conn_lock:
-                                send_frame(conn, np.asarray(o, np.float32),
-                                           codec=codec)
-                        with conn_lock:
-                            send_end(conn)
-                        return
-                    slab, mask = pipe.push(
-                        block.reshape(pipe.chunk, mb, buf), n_real=got,
-                        staged=True, raw=True)
+                        if not owners:
+                            continue
+                        # undelivered rows are inside the pipe and no new
+                        # traffic is arriving: crank it with the cached
+                        # device-resident bubble block (flush()'s recipe)
+                        got, block = 0, pipe._bubble_block()
+                    if block is None:
+                        continue  # ring closed (teardown)
+                    xs = block if got == 0 else \
+                        block.reshape(pipe.chunk, mb, buf)
+                    slab, mask = pipe.push(xs, n_real=got,
+                                           staged=got > 0, raw=True)
                     if slab is None:
                         continue
                     real = np.flatnonzero(mask)
@@ -373,21 +545,30 @@ class Defer:
                         slab = slab[real]
                     # ONE device->host drain per chunk, then frame out
                     arr = np.asarray(slab, np.float32)
-                    out_shape = (mb,) + pipe.out_spec.shape
                     for row in arr:
-                        with conn_lock:
-                            send_frame(conn, row.reshape(out_shape),
-                                       codec=codec)
-            except BaseException as e:  # noqa: BLE001 — surfaced on .errors
+                        _deliver(row, out_shape)
+            except BaseException as e:  # noqa: BLE001 — endpoint-fatal
                 errors.append(e)
                 raise
             finally:
-                conn.close()
+                ring.close()
                 srv.close()
+                # endpoint-fatal exit: cut every live client WITHOUT an END
+                # echo so remote peers fail loudly instead of blocking in
+                # recv forever (normal exits find no one alive here)
+                for c in clients:
+                    _finish(c, send_eos=False)
 
         thread = threading.Thread(target=serve, daemon=True,
                                   name="defer-endpoint")
         thread.errors = errors  # inspectable post-join
+
+        def _stop():
+            stop_ev.set()
+            srv.close()  # unblocks the acceptor; serve loop exits after
+            #              draining whatever rows are already in flight
+
+        thread.stop = _stop
         thread.start()
         return address, thread
 
@@ -404,44 +585,44 @@ class Defer:
         stop = threading.Event()
         cfg = self.config
 
-        def serve():
-            try:
-                _serve_inner()
-            except BaseException as e:  # surface errors instead of a silent
-                handle.error = e        # dead thread + forever-blocked reader
-                output_stream.put(END_OF_STREAM)
-
-        def _dispatch(fn, *a, arm=True, **kw):
+        def _dispatch(gen, fn, *a, arm=True, **kw):
             # bracket device work so the watchdog can tell "waiting for
             # input" (fine) from "stuck in a dispatch" (dead pipeline).
             # arm=False exempts dispatches that may legitimately block for
             # an XLA compile (new input shape in MPMD mode) — a compile is
-            # not a hang, however long it takes.
+            # not a hang, however long it takes.  All handle bookkeeping is
+            # generation-guarded: a wedged thread that unwedges after a
+            # recovery must not clobber the live generation's markers.
             t0 = time.monotonic()
-            if arm:
+            if arm and handle._gen == gen:
                 handle._busy_since = t0
             try:
                 out = fn(*a, **kw)
             finally:
-                handle._busy_since = None
-            handle._dispatches += 1
-            handle._max_dispatch_s = max(handle._max_dispatch_s,
-                                         time.monotonic() - t0)
+                if handle._gen == gen:
+                    handle._busy_since = None
+            if handle._gen == gen:
+                handle._dispatches += 1
+                handle._max_dispatch_s = max(handle._max_dispatch_s,
+                                             time.monotonic() - t0)
             return out
 
-        def _serve_inner():
+        def _serve_inner(pipe, replay, gen):
+            def live() -> bool:
+                return handle._gen == gen and handle.error is None
+
             if isinstance(pipe, MpmdPipeline):
                 if cfg.preflight:
                     # compile-and-run probe before serving traffic (the
                     # reference has no health check at all: a bad partition
                     # only surfaces when a node dies mid-stream, SURVEY.md §5)
-                    _dispatch(pipe.run, np.zeros(
+                    _dispatch(gen, pipe.run, np.zeros(
                         (1, pipe.microbatch) + pipe.in_spec.shape, np.float32))
-                    if handle.error is not None:
+                    if not live():
                         return
                 seen_shapes: set[tuple] = set()
                 pipe.reset()
-                while not stop.is_set():
+                while not stop.is_set() and live():
                     try:
                         x = input_stream.get(timeout=0.05)
                     except queue.Empty:
@@ -457,82 +638,128 @@ class Defer:
                     # enqueues async work, and a wedged device would
                     # otherwise hang np.asarray with the watchdog disarmed
                     outs = _dispatch(
+                        gen,
                         lambda: [np.asarray(o, np.float32)
                                  for o in pipe.push(xa[None])],
                         arm=not fresh)
-                    if handle.error is not None:
+                    if not live():
                         return  # watchdog fired mid-dispatch
                     for o in outs:
                         output_stream.put(o)
-                if handle.error is not None:
+                if not live():
                     return
-                outs = _dispatch(lambda: [np.asarray(o, np.float32)
-                                          for o in pipe.flush()])
-                if handle.error is not None:
+                outs = _dispatch(gen, lambda: [np.asarray(o, np.float32)
+                                               for o in pipe.flush()])
+                if not live():
                     return
                 for o in outs:
                     output_stream.put(o)
                 return
 
+            # ---- SPMD path: resubmit log + replay-aware input feed ----
+            log = handle._resubmit
+            log_cap = 2 * (pipe.chunk + pipe.num_stages + 1)
+            pending: collections.deque = collections.deque(replay)
+
+            def next_input(timeout: float):
+                if pending:
+                    return pending.popleft()
+                if handle._end_seen:
+                    # the caller's END was consumed by a previous (wedged)
+                    # generation; never wait for a second one
+                    raise queue.Empty
+                return input_stream.get(timeout=timeout)
+
             pipe.reset()
             if cfg.preflight:
                 # serve the first real input from an already-validated,
-                # already-compiled full-chunk program
-                _dispatch(pipe.warmup)
-                if handle.error is not None:
+                # already-compiled full-chunk program.  arm=False: on a
+                # recovery generation _dispatches is already > 0 and this
+                # (compile) dispatch would otherwise re-trip the watchdog
+                _dispatch(gen, pipe.warmup, arm=False)
+                if not live():
                     return
             done = False
-            while not done and not stop.is_set():
+            while not done and not stop.is_set() and live():
+                if handle._end_seen and not pending:
+                    break  # recovery after END: replay done, go flush
                 batch: list[np.ndarray] = []
                 try:
-                    batch.append(input_stream.get(timeout=0.05))
+                    batch.append(next_input(0.05))
                 except queue.Empty:
+                    if handle._end_seen:
+                        break
                     continue
                 if batch[0] is END_OF_STREAM:
+                    handle._end_seen = True
                     break
                 # opportunistically gather a fuller chunk (the reference's
                 # in-flight window); don't stall waiting for stragglers
                 while len(batch) < pipe.chunk:
                     try:
-                        nxt = input_stream.get(timeout=cfg.gather_timeout_s)
+                        nxt = next_input(cfg.gather_timeout_s)
                     except queue.Empty:
                         break
                     if nxt is END_OF_STREAM:
+                        handle._end_seen = True
                         done = True
                         break
                     batch.append(nxt)
                 n_real = len(batch)
                 pad = [np.zeros_like(batch[0])] * (pipe.chunk - n_real)
                 block = np.stack(batch + pad)
+                # record the fed microbatches BEFORE dispatch: if the
+                # dispatch wedges, the recovery generation replays exactly
+                # these (plus everything older still in the pipe)
+                log.extend(batch)
+                if len(log) > log_cap:  # can't happen: pops track emits
+                    raise RuntimeError(
+                        f"resubmit log overflow ({len(log)} > {log_cap})")
                 # materialize inside the bracket (push is async dispatch;
                 # the device block happens at np.asarray)
                 outs = _dispatch(
+                    gen,
                     lambda: [np.asarray(o, np.float32)
                              for o in pipe.push(block, n_real=n_real)])
-                if handle.error is not None:
+                if not live():
                     return  # watchdog fired mid-dispatch; sentinel is out
                 for o in outs:
+                    log.popleft()  # emitted: no longer replayable
                     output_stream.put(o)
-            if handle.error is not None:
+            if not live():
                 return
-            outs = _dispatch(lambda: [np.asarray(o, np.float32)
-                                      for o in pipe.flush()])
-            if handle.error is not None:
+            outs = _dispatch(gen, lambda: [np.asarray(o, np.float32)
+                                           for o in pipe.flush()])
+            if not live():
                 # watchdog fired during the drain dispatch: the sentinel is
                 # already on the queue; emitting outputs after it would
                 # violate the stream protocol for readers
                 return
             for o in outs:
+                log.popleft()
                 output_stream.put(o)
 
-        thread = threading.Thread(target=serve, daemon=True,
-                                  name="defer-dispatcher")
-        handle = DeferHandle(thread, pipe, stop)
-        thread.start()
+        def start_generation(pipe, replay, gen):
+            def serve():
+                try:
+                    _serve_inner(pipe, replay, gen)
+                except BaseException as e:  # surface errors instead of a
+                    if handle._gen == gen:  # silent dead thread + forever-
+                        handle.error = e    # blocked reader
+                        output_stream.put(END_OF_STREAM)
+
+            t = threading.Thread(target=serve, daemon=True,
+                                 name=f"defer-dispatcher-g{gen}")
+            handle._thread = t
+            handle.pipeline = pipe
+            t.start()
+
+        handle = DeferHandle(None, pipe, stop)
+        start_generation(pipe, [], 0)
 
         if cfg.watchdog_s is not None:
             def watch():
-                while not stop.is_set() and thread.is_alive():
+                while not stop.is_set() and handle._thread.is_alive():
                     busy = handle._busy_since
                     # threshold self-scales to the slowest dispatch this
                     # deployment has actually completed (compile included):
@@ -544,8 +771,31 @@ class Defer:
                     # legitimately blocks for the whole jit compile
                     if (handle._dispatches > 0 and busy is not None
                             and time.monotonic() - busy > wd):
-                        # a dead device/backend: surface instead of the
-                        # reference's forever-hang (SURVEY.md §5 failure row)
+                        if (handle.recoveries < cfg.max_recoveries
+                                and not isinstance(handle.pipeline,
+                                                   MpmdPipeline)):
+                            # RECOVER (SURVEY §5 upgraded from "surface the
+                            # hang" to "survive it"): abandon the wedged
+                            # generation, rebuild the pipeline fresh, and
+                            # replay the fed-but-unemitted microbatches
+                            handle.recoveries += 1
+                            handle._gen += 1
+                            handle._busy_since = None
+                            replay = list(handle._resubmit)
+                            handle._resubmit.clear()
+                            try:
+                                new_pipe = self.build(graph, params,
+                                                      cut_points, num_stages)
+                            except BaseException as e:  # noqa: BLE001
+                                handle.error = e
+                                stop.set()
+                                output_stream.put(END_OF_STREAM)
+                                return
+                            start_generation(new_pipe, replay, handle._gen)
+                            continue
+                        # out of recoveries (or MPMD): a dead device/backend
+                        # surfaces instead of the reference's forever-hang
+                        # (SURVEY.md §5 failure row)
                         handle.error = TimeoutError(
                             f"pipeline dispatch made no progress for "
                             f"{wd:.1f}s; deployment declared dead")

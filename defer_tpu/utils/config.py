@@ -65,3 +65,10 @@ class DeferConfig:
     # before serving traffic, so compile failures surface as handle.error
     # immediately instead of mid-stream
     preflight: bool = True
+    # recovery, not just detection: when the watchdog declares a dispatch
+    # hung, up to this many times the dispatcher REBUILDS the pipeline
+    # (fresh jit, same weights), replays the fed-but-unemitted microbatches
+    # from the resubmit log, and resumes the stream — the wedged thread is
+    # abandoned (its generation can no longer emit).  0 restores
+    # detection-only (error + sentinel on first fire).  SPMD mode only.
+    max_recoveries: int = 1

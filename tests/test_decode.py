@@ -290,6 +290,81 @@ def test_defer_score(model, prompt):
     assert (ppl > 0).all() and np.allclose(ppl, np.exp(-lp / 9), rtol=1e-6)
 
 
+def test_defer_score_bucketed_short_sequence(model):
+    """Scoring T=6 under a 24-token graph routes through a power-of-two
+    bucketed pipeline (8 positions, not 24) with identical results."""
+    import defer_tpu as dt
+    graph, params = model
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, VOCAB, size=(4, 6)).astype(np.int32)
+    defer = dt.Defer(config=dt.DeferConfig(microbatch=2, chunk=4))
+    lp, ppl = defer.score(graph, params, ids, num_stages=4)
+    # the cached pipeline really is the short-bucket one
+    (g_ref, p_ref, pipe), = [v for k, v in defer._score_cache.items()]
+    assert g_ref is graph and p_ref is params
+    assert pipe.in_spec.shape[0] == 8  # next pow2 >= 6
+    # identical log-likelihoods vs the full-length direct computation
+    logits = np.asarray(graph.apply(params, jnp.asarray(
+        np.pad(ids, ((0, 0), (0, MAX_LEN - 6))))))[:, :6]
+    ref_logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), -1)
+    pick = jnp.take_along_axis(ref_logp[:, :-1],
+                               jnp.asarray(ids[:, 1:, None]), -1)[..., 0]
+    np.testing.assert_allclose(lp, np.asarray(pick.sum(-1)), rtol=1e-4)
+    # second call with the same (graph, params, T-bucket) reuses the pipe
+    defer.score(graph, params, ids, num_stages=4)
+    assert len(defer._score_cache) == 1
+
+
+@pytest.mark.slow
+def test_defer_score_bucket_speedup():
+    """The bucketed path must actually be cheaper: steady-state scoring of
+    short sequences beats the full-length pipeline by >=4x (VERDICT r4 #8
+    'done' bar).  Needs a compute-dominated config (T=256, d=128) so the
+    per-dispatch overhead doesn't mask the work ratio; timed on compiled,
+    warmed pipelines, min over reps."""
+    import time
+    import defer_tpu as dt
+    from defer_tpu.models.gpt import gpt
+    graph = gpt(4, 128, 4, seq_len=256, vocab=VOCAB, name="gpt_score_perf")
+    params = graph.init(jax.random.key(2))
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, VOCAB, size=(4, 10)).astype(np.int32)
+    defer = dt.Defer(config=dt.DeferConfig(microbatch=2, chunk=4))
+
+    def steady(fn):
+        fn()  # compile/warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    short = steady(lambda: defer.score(graph, params, ids, num_stages=4))
+    full_ids = np.zeros((4, 256), np.int32)
+    full_ids[:, :10] = ids  # the old behavior: pad to the graph's length
+    full = steady(lambda: defer.score(graph, params, full_ids,
+                                      num_stages=4))
+    assert full / short >= 4, (short, full)
+
+
+def test_defer_generate_caches_decoder(model, prompt):
+    """Repeated Defer.generate reuses one PipelinedDecoder (ADVICE r4):
+    rebuilding repacked weights + re-jitted the decode program per call."""
+    import defer_tpu as dt
+    graph, params = model
+    defer = dt.Defer(config=dt.DeferConfig(microbatch=2))
+    a = defer.generate(graph, params, prompt, 4, num_stages=4)
+    dec1 = next(iter(defer._decoder_cache.values()))[2]
+    b = defer.generate(graph, params, prompt, 4, num_stages=4)
+    dec2 = next(iter(defer._decoder_cache.values()))[2]
+    assert dec1 is dec2 and len(defer._decoder_cache) == 1
+    np.testing.assert_array_equal(a, b)
+    # different kv_cache => different engine, cache grows
+    defer.generate(graph, params, prompt, 4, num_stages=4, kv_cache="int8")
+    assert len(defer._decoder_cache) == 2
+
+
 def test_gqa_int8_prefill_sampling_compose(prompt):
     """All decoder features at once: GQA + int8 cache + fused prefill +
     top-k sampling + chunking + EOS, generating to the max_len boundary."""

@@ -59,8 +59,9 @@ def test_run_defer_propagates_stage_error(tiny):
 
 def test_watchdog_declares_hung_dispatch(tiny, monkeypatch):
     g, p = tiny
+    # detection-only mode (max_recoveries=0): first fire is fatal
     defer = Defer(config=DeferConfig(microbatch=1, chunk=2,
-                                     watchdog_s=0.5))
+                                     watchdog_s=0.5, max_recoveries=0))
     in_q, out_q = queue.Queue(), queue.Queue()
     h = defer.run_defer(g, p, None, in_q, out_q, num_stages=2)
     # simulate a wedged device dispatch (e.g. a dead TPU tunnel) AFTER the
@@ -79,6 +80,108 @@ def test_failure_detection_defaults_on():
     cfg = DeferConfig()
     assert cfg.watchdog_s == 60.0
     assert cfg.preflight is True
+    assert cfg.max_recoveries == 1  # recovery, not just detection (r5)
+
+
+def test_watchdog_recovery_replays_unemitted(tiny):
+    """VERDICT r4 #7: poison a dispatch mid-stream; the watchdog rebuilds
+    the pipeline (fresh jit, same weights), replays the fed-but-unemitted
+    microbatches from the resubmit log, and the output queue completes
+    with no gaps — in order, matching the single-program oracle."""
+    import threading
+
+    g, p = tiny
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2, watchdog_s=0.5,
+                                     gather_timeout_s=0.01))
+    in_q, out_q = queue.Queue(), queue.Queue()
+    h = defer.run_defer(g, p, None, in_q, out_q, num_stages=2)
+
+    # poison the FIRST pipeline instance: its 3rd push (warmup is #1)
+    # wedges forever — the simulated dead-device dispatch
+    first_pipe = h.pipeline
+    real_push = first_pipe.push
+    wedge = threading.Event()
+    calls = {"n": 0}
+
+    def poisoned(xs, n_real=None, **kw):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            wedge.wait()  # never set: this generation is stuck for good
+        return real_push(xs, n_real=n_real, **kw)
+
+    first_pipe.push = poisoned
+
+    rng = np.random.default_rng(7)
+    xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+          for _ in range(8)]
+    for x in xs:
+        in_q.put(x)
+    in_q.put(END_OF_STREAM)
+
+    outs = []
+    while len(outs) < 8:
+        o = out_q.get(timeout=180)
+        assert o is not END_OF_STREAM, \
+            f"stream aborted after {len(outs)} outputs (error: {h.error!r})"
+        outs.append(o)
+    assert h.healthy
+    assert h.recoveries == 1
+    assert h.pipeline is not first_pipe  # fresh engine, same weights
+    fwd = jax.jit(g.apply)
+    for x, y in zip(xs, outs):  # no gaps, original feed order
+        np.testing.assert_allclose(y, np.asarray(fwd(p, x)),
+                                   rtol=2e-4, atol=2e-4)
+    h.stop()
+    wedge.set()  # let the abandoned generation's thread exit
+
+
+def test_watchdog_recovery_after_end_consumed(tiny):
+    """A wedge in the final-drain dispatch — AFTER the caller's
+    END_OF_STREAM was consumed — must still recover: the new generation
+    must not wait for a second END (none is coming); it replays, flushes,
+    and completes the stream."""
+    import threading
+
+    g, p = tiny
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=2, watchdog_s=0.5,
+                                     gather_timeout_s=0.01))
+    in_q, out_q = queue.Queue(), queue.Queue()
+    h = defer.run_defer(g, p, None, in_q, out_q, num_stages=2)
+
+    first_pipe = h.pipeline
+    real_push = first_pipe.push
+    wedge = threading.Event()
+    calls = {"n": 0}
+
+    def poisoned(xs, n_real=None, **kw):
+        calls["n"] += 1
+        # warmup=1, two input chunks=2..3, flush pushes start at 4
+        if calls["n"] == 4:
+            wedge.wait()
+        return real_push(xs, n_real=n_real, **kw)
+
+    first_pipe.push = poisoned
+
+    rng = np.random.default_rng(13)
+    xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+          for _ in range(4)]
+    for x in xs:
+        in_q.put(x)
+    in_q.put(END_OF_STREAM)
+
+    outs = []
+    while len(outs) < 4:
+        o = out_q.get(timeout=180)
+        assert o is not END_OF_STREAM, \
+            f"aborted after {len(outs)} (error: {h.error!r})"
+        outs.append(o)
+    assert h.healthy and h.recoveries == 1
+    fwd = jax.jit(g.apply)
+    for x, y in zip(xs, outs):
+        np.testing.assert_allclose(y, np.asarray(fwd(p, x)),
+                                   rtol=2e-4, atol=2e-4)
+    h.stop()
+    wedge.set()
 
 
 def test_join_raises_immediately_when_error_set():
