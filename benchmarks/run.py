@@ -67,8 +67,35 @@ def sample(shape, kind, microbatch, lead=()):
     return np.zeros(full, np.float32)
 
 
+#: config name -> (pretrained-loader family, checkpoint basename)
+PRETRAINED = {
+    "resnet50_8": "resnet50",
+    "vgg19_4": "vgg19",
+    "mobilenetv2_2": "mobilenet_v2",
+}
+
+
+def _load_weights(name: str, graph, weights_dir: str | None):
+    """Trained weights for a full config when a checkpoint is present
+    (reference parity: it benchmarks ResNet50(weights="imagenet"),
+    test/test.py:13-14).  Returns (params, trained?)."""
+    family = PRETRAINED.get(name)
+    if weights_dir and family:
+        import os
+        from defer_tpu.utils.pretrained import load_pretrained
+        for ext in (".pt", ".pth", ".npz", ".safetensors", ".bin"):
+            p = os.path.join(weights_dir, family + ext)
+            if os.path.exists(p):
+                log(f"{name}: loading trained weights {p}")
+                return load_pretrained(family, p, graph), True
+        log(f"{name}: no {family}.* checkpoint in {weights_dir}; "
+            f"random init")
+    return graph.init(jax.random.key(0)), False
+
+
 def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
-               microbatch: int = 1, force_full: bool = False):
+               microbatch: int = 1, force_full: bool = False,
+               weights_dir: str | None = None):
     (full_fn, full_cuts, full_shape, full_kind,
      tiny_fn, tiny_stages, tiny_shape, tiny_kind) = CONFIGS[name]
     on_tpu = jax.default_backend() == "tpu"
@@ -87,7 +114,8 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
         cuts, num_stages, want = None, n_dev, n_dev
         log(f"{name}: only {n_dev} devices; auto-partitioning to {n_dev}")
 
-    params = graph.init(jax.random.key(0))
+    params, trained = _load_weights(name, graph,
+                                    weights_dir if use_full else None)
     compute_dtype = jnp.bfloat16 if on_tpu and kind == "f" else None
 
     # single-device baseline (reference test/local_infer.py semantics),
@@ -136,6 +164,7 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
         "vs_baseline": round(base_s / pipe_s, 4),
         "vs_stepwise_baseline": round(base_step_s / pipe_s, 4),
         "stages": len(stages),
+        "trained_weights": trained,
         "microbatch": microbatch,
         "chunk": chunk,
         "single_device_s": round(base_s, 6),
@@ -215,6 +244,9 @@ def main():
                     help="steps fused per dispatch (0 = 128 on TPU, 16 off)")
     ap.add_argument("--microbatch", type=int, default=1)
     ap.add_argument("--no-stage-latency", action="store_true")
+    ap.add_argument("--weights-dir", default=None,
+                    help="directory of trained checkpoints "
+                         "(resnet50.pt, vgg19.pt, mobilenet_v2.pt, ...)")
     args = ap.parse_args()
 
     chunk = args.chunk or (128 if jax.default_backend() == "tpu" else 16)
@@ -228,7 +260,8 @@ def main():
             r = run_config(name, tiny=args.tiny, chunk=chunk,
                            microbatch=args.microbatch,
                            stage_lat=not args.no_stage_latency,
-                           force_full=args.full)
+                           force_full=args.full,
+                           weights_dir=args.weights_dir)
         except Exception as e:  # noqa: BLE001 — keep the suite going
             log(f"{name}: FAILED {type(e).__name__}: {e}")
             continue

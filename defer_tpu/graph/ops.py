@@ -31,6 +31,18 @@ def _cast(p, dtype):
     return jax.tree.map(lambda a: a.astype(dtype), p)
 
 
+def _sym_pad(padding, *, nhwc: bool = False):
+    """Normalize a padding spec: "SAME"/"VALID" pass through; an explicit
+    symmetric ``(ph, pw)`` becomes lax pad pairs (spatial-only, or padded
+    out to NHWC rank for reduce_window).  The ONE place the convention
+    lives — conv, depthwise, and pooling all route through it."""
+    if isinstance(padding, str):
+        return padding
+    ph, pw = padding
+    pairs = ((ph, ph), (pw, pw))
+    return ((0, 0), *pairs, (0, 0)) if nhwc else list(pairs)
+
+
 # ---------------------------------------------------------------------------
 # dense / conv
 # ---------------------------------------------------------------------------
@@ -94,7 +106,11 @@ class Conv2D(Op):
     features: int
     kernel: int | tuple[int, int] = 3
     stride: int | tuple[int, int] = 1
-    padding: str = "SAME"  # or "VALID"
+    #: "SAME"/"VALID", or an explicit symmetric (ph, pw) pad.  The tuple
+    #: form exists for torch-trained weights: torch pads stride-2 convs
+    #: symmetrically (k//2 each side) where XLA SAME pads (0, 1)-style
+    #: asymmetrically — numerically different at every downsampling conv.
+    padding: str | tuple[int, int] = "SAME"
     use_bias: bool = True
     groups: int = 1
 
@@ -105,6 +121,9 @@ class Conv2D(Op):
     def _s(self):
         s = self.stride
         return (s, s) if isinstance(s, int) else tuple(s)
+
+    def _p(self):
+        return _sym_pad(self.padding)
 
     def init(self, key, in_specs):
         (spec,) = in_specs
@@ -122,7 +141,7 @@ class Conv2D(Op):
     def apply(self, params, x):
         p = _cast(params, x.dtype)
         y = lax.conv_general_dilated(
-            x, p["w"], window_strides=self._s(), padding=self.padding,
+            x, p["w"], window_strides=self._s(), padding=self._p(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.groups,
         )
@@ -141,7 +160,8 @@ class Conv2D(Op):
 class DepthwiseConv2D(Op):
     kernel: int = 3
     stride: int = 1
-    padding: str = "SAME"
+    #: "SAME"/"VALID" or explicit symmetric (ph, pw) — see Conv2D.padding
+    padding: str | tuple[int, int] = "SAME"
     use_bias: bool = False  # enabled by the BatchNorm-folding pass
 
     def init(self, key, in_specs):
@@ -159,7 +179,7 @@ class DepthwiseConv2D(Op):
         c = x.shape[-1]
         y = lax.conv_general_dilated(
             x, p["w"], window_strides=(self.stride, self.stride),
-            padding=self.padding,
+            padding=_sym_pad(self.padding),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=c,
         )
@@ -248,7 +268,8 @@ class Activation(Op):
 class MaxPool(Op):
     window: int = 2
     stride: int | None = None
-    padding: str = "VALID"
+    #: "SAME"/"VALID" or explicit symmetric (ph, pw) — see Conv2D.padding
+    padding: str | tuple[int, int] = "VALID"
 
     def apply(self, params, x):
         del params
@@ -259,7 +280,8 @@ class MaxPool(Op):
             identity = jnp.iinfo(x.dtype).min
         return lax.reduce_window(
             x, identity, lax.max,
-            (1, self.window, self.window, 1), (1, s, s, 1), self.padding)
+            (1, self.window, self.window, 1), (1, s, s, 1),
+            _sym_pad(self.padding, nhwc=True))
 
 
 @functools.lru_cache(maxsize=256)

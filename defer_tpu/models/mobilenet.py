@@ -17,7 +17,10 @@ from ..graph.ops import (Activation, Add, BatchNorm, Conv2D, Dense,
 
 
 def _cbr6(b, x, feats, kernel, stride=1):
-    x = b.add(Conv2D(feats, kernel, stride, use_bias=False), x)
+    # symmetric k//2 padding: torch's convention (== SAME at stride 1,
+    # differs from XLA SAME at stride 2) so torchvision weights reproduce
+    x = b.add(Conv2D(feats, kernel, stride, (kernel // 2, kernel // 2),
+                     use_bias=False), x)
     x = b.add(BatchNorm(), x)
     return b.add(Activation("relu6"), x)
 
@@ -27,7 +30,7 @@ def _inverted_residual(b: GraphBuilder, x: str, in_ch: int, out_ch: int,
     inp = x
     if expand != 1:
         x = _cbr6(b, x, in_ch * expand, 1)
-    x = b.add(DepthwiseConv2D(3, stride), x)
+    x = b.add(DepthwiseConv2D(3, stride, (1, 1)), x)
     x = b.add(BatchNorm(), x)
     x = b.add(Activation("relu6"), x)
     x = b.add(Conv2D(out_ch, 1, use_bias=False), x)

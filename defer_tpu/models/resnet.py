@@ -18,8 +18,12 @@ from ..graph.ops import (Activation, Add, BatchNorm, Conv2D, Dense,
 
 
 def _conv_bn(b: GraphBuilder, x: str, features: int, kernel: int,
-             stride: int = 1, relu: bool = True, padding: str = "SAME") -> str:
-    x = b.add(Conv2D(features, kernel, stride, padding, use_bias=False), x)
+             stride: int = 1, relu: bool = True) -> str:
+    # explicit symmetric k//2 padding == SAME at stride 1, and matches
+    # torch's convention at stride 2 (where XLA SAME pads asymmetrically)
+    # so torchvision-trained weights reproduce bit-comparable activations
+    pad = (kernel // 2, kernel // 2)
+    x = b.add(Conv2D(features, kernel, stride, pad, use_bias=False), x)
     x = b.add(BatchNorm(), x)
     if relu:
         x = b.add(Activation("relu"), x)
@@ -28,12 +32,16 @@ def _conv_bn(b: GraphBuilder, x: str, features: int, kernel: int,
 
 def _bottleneck(b: GraphBuilder, x: str, features: int, stride: int,
                 project: bool, add_idx: int) -> str:
-    """Post-activation bottleneck block ending in a named ``add_k`` node."""
+    """Post-activation bottleneck block ending in a named ``add_k`` node.
+
+    Stride lives on the 3x3 conv (ResNet v1.5) — torchvision's layout, so
+    its checkpoints import with matching semantics, not just shapes.
+    """
     shortcut = x
     if project:
         shortcut = _conv_bn(b, x, 4 * features, 1, stride, relu=False)
-    y = _conv_bn(b, x, features, 1, stride)
-    y = _conv_bn(b, y, features, 3, 1)
+    y = _conv_bn(b, x, features, 1, 1)
+    y = _conv_bn(b, y, features, 3, stride)
     y = _conv_bn(b, y, 4 * features, 1, 1, relu=False)
     name = "add" if add_idx == 0 else f"add_{add_idx}"
     out = b.add(Add(), [y, shortcut], name=name)
@@ -45,7 +53,7 @@ def resnet(depths: list[int], width: int = 64, num_classes: int = 1000,
     b = GraphBuilder(name)
     x = b.input((image_size, image_size, 3), jnp.float32)
     x = _conv_bn(b, x, width, 7, 2)
-    x = b.add(MaxPool(3, 2, padding="SAME"), x)
+    x = b.add(MaxPool(3, 2, padding=(1, 1)), x)
     add_idx = 0
     for s, blocks in enumerate(depths):
         feats = width * (2 ** s)

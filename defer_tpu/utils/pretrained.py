@@ -94,6 +94,111 @@ def resnet50_torch_mapping(depths=(3, 4, 6, 3)
     return m
 
 
+def _fc1_t(h: int, w: int, c: int) -> Callable[[np.ndarray], np.ndarray]:
+    """First-FC transform for VGG: torch flattens NCHW ([C,H,W] order per
+    sample), this framework flattens NHWC — the weight's input axis must be
+    re-permuted, not just transposed."""
+    def t(a: np.ndarray) -> np.ndarray:
+        out = a.shape[0]
+        return (a.reshape(out, c, h, w).transpose(0, 2, 3, 1)
+                .reshape(out, -1).T)
+    t.__name__ = "_fc1_t"
+    return t
+
+
+def vgg_torch_mapping(cfg, spatial_hwc: tuple[int, int, int]
+                      ) -> dict[tuple[str, str], tuple[str, Callable]]:
+    """(our_node, our_leaf) -> (torchvision key, transform) for a VGG built
+    by ``models.vgg.vgg(cfg, ...)``.
+
+    torchvision's ``features`` Sequential numbers conv/relu/maxpool slots
+    consecutively; the builder names ``conv{block}_{i}``.  ``spatial_hwc``
+    is the activation shape entering ``flatten`` (needed because torch
+    flattens CHW, we flatten HWC — see ``_fc1_t``).
+    """
+    m: dict[tuple[str, str], tuple[str, Callable]] = {}
+    feat_idx = 0
+    block, conv_in_block = 1, 1
+    for v in cfg:
+        if v == "M":
+            feat_idx += 1
+            block += 1
+            conv_in_block = 1
+        else:
+            node = f"conv{block}_{conv_in_block}"
+            m[(node, "w")] = (f"features.{feat_idx}.weight", _conv_t)
+            m[(node, "b")] = (f"features.{feat_idx}.bias", _ident)
+            feat_idx += 2  # conv + its relu
+            conv_in_block += 1
+    h, w, c = spatial_hwc
+    m[("fc1", "w")] = ("classifier.0.weight", _fc1_t(h, w, c))
+    m[("fc1", "b")] = ("classifier.0.bias", _ident)
+    m[("fc2", "w")] = ("classifier.3.weight", _fc_t)
+    m[("fc2", "b")] = ("classifier.3.bias", _ident)
+    m[("predictions", "w")] = ("classifier.6.weight", _fc_t)
+    m[("predictions", "b")] = ("classifier.6.bias", _ident)
+    return m
+
+
+def mobilenet_v2_torch_mapping() -> dict[tuple[str, str],
+                                         tuple[str, Callable]]:
+    """(our_node, our_leaf) -> (torchvision key, transform) for
+    ``models.mobilenet.mobilenet_v2``.
+
+    Mirrors the builder's auto-naming counters (conv2d_k / batchnorm_k /
+    depthwiseconv2d_k in build order) against torchvision's module tree:
+    ``features.0`` ConvBNReLU stem, ``features.1..17`` InvertedResiduals
+    (``.conv`` holds [expand ConvBNReLU,] depthwise ConvBNReLU, linear
+    conv, bn), ``features.18`` ConvBNReLU head, ``classifier.1`` Linear.
+    Depthwise kernels are OIHW ``[C,1,k,k]`` -> HWIO ``[k,k,1,C]`` via the
+    same transpose as dense convs.
+    """
+    from ..models.mobilenet import _V2_CFG
+    m: dict[tuple[str, str], tuple[str, Callable]] = {}
+    counters = {"conv2d": 0, "batchnorm": 0, "depthwiseconv2d": 0}
+
+    def nm(base: str) -> str:
+        n = counters[base]
+        counters[base] += 1
+        return base if n == 0 else f"{base}_{n}"
+
+    def conv(src: str):
+        m[(nm("conv2d"), "w")] = (f"{src}.weight", _conv_t)
+
+    def dwconv(src: str):
+        m[(nm("depthwiseconv2d"), "w")] = (f"{src}.weight", _conv_t)
+
+    def bn(src: str):
+        node = nm("batchnorm")
+        for theirs, ours in _BN_LEAVES.items():
+            m[(node, ours)] = (f"{src}.{theirs}", _ident)
+
+    conv("features.0.0")
+    bn("features.0.1")
+    f = 1
+    for expand, _out, reps, _stride in _V2_CFG:
+        for _ in range(reps):
+            base = f"features.{f}.conv"
+            f += 1
+            if expand != 1:
+                conv(f"{base}.0.0")
+                bn(f"{base}.0.1")
+                dwconv(f"{base}.1.0")
+                bn(f"{base}.1.1")
+                conv(f"{base}.2")
+                bn(f"{base}.3")
+            else:
+                dwconv(f"{base}.0.0")
+                bn(f"{base}.0.1")
+                conv(f"{base}.1")
+                bn(f"{base}.2")
+    conv(f"features.{f}.0")
+    bn(f"features.{f}.1")
+    m[("predictions", "w")] = ("classifier.1.weight", _fc_t)
+    m[("predictions", "b")] = ("classifier.1.bias", _ident)
+    return m
+
+
 def _read_state_dict(path: str) -> dict[str, np.ndarray]:
     ext = os.path.splitext(path)[1].lower()
     if ext == ".npz":
@@ -118,16 +223,17 @@ def _read_state_dict(path: str) -> dict[str, np.ndarray]:
                      f"(want .npz, .pt/.pth/.bin, or .safetensors)")
 
 
-def convert_resnet50_state_dict(sd: dict[str, np.ndarray],
-                                expected: dict[str, Any],
-                                depths=(3, 4, 6, 3)) -> dict[str, Any]:
-    """torchvision ``state_dict`` -> graph params, shape-checked leaf by leaf.
+def convert_state_dict(mapping: dict[tuple[str, str], tuple[str, Callable]],
+                       sd: dict[str, np.ndarray],
+                       expected: dict[str, Any],
+                       what: str) -> dict[str, Any]:
+    """Apply a (our_node, our_leaf) -> (source_key, transform) mapping,
+    shape-checked leaf by leaf.
 
     ``expected`` is the pytree from ``graph.init`` — its shapes are the
     contract; any missing source key or post-transform shape mismatch
     raises with the full offending list (no silent partial loads).
     """
-    mapping = resnet50_torch_mapping(depths)
     out: dict[str, Any] = {}
     missing, mismatched = [], []
     for (node, leaf), (src, tf) in mapping.items():
@@ -143,7 +249,7 @@ def convert_resnet50_state_dict(sd: dict[str, np.ndarray],
         out.setdefault(node, {})[leaf] = arr.astype(np.float32)
     if missing or mismatched:
         raise ValueError(
-            f"checkpoint does not match ResNet50: "
+            f"checkpoint does not match {what}: "
             f"{len(missing)} missing keys {missing[:5]}..., "
             f"{len(mismatched)} shape mismatches {mismatched[:5]}")
     # parameter-free nodes (activations, pools, adds) keep their (empty)
@@ -152,6 +258,14 @@ def convert_resnet50_state_dict(sd: dict[str, np.ndarray],
         if node not in out:
             out[node] = leaves
     return out
+
+
+def convert_resnet50_state_dict(sd: dict[str, np.ndarray],
+                                expected: dict[str, Any],
+                                depths=(3, 4, 6, 3)) -> dict[str, Any]:
+    """torchvision ResNet ``state_dict`` -> graph params (shape-checked)."""
+    return convert_state_dict(resnet50_torch_mapping(depths), sd, expected,
+                              "ResNet50")
 
 
 def load_pretrained_resnet50(path: str, graph: LayerGraph | None = None,
@@ -176,3 +290,58 @@ def load_pretrained_resnet50(path: str, graph: LayerGraph | None = None,
     # restores it with loud missing/extra/shape validation
     from .checkpoint import load_params
     return load_params(path, expected)
+
+
+def _expected_shapes(graph: LayerGraph):
+    import jax
+    return jax.eval_shape(lambda: graph.init(jax.random.key(0)))
+
+
+def load_pretrained_vgg19(path: str,
+                          graph: LayerGraph | None = None) -> dict[str, Any]:
+    """Load a VGG19 checkpoint (torchvision layout or our flat layout)."""
+    if graph is None:
+        from ..models import vgg19
+        graph = vgg19()
+    expected = _expected_shapes(graph)
+    sd = _read_state_dict(path)
+    if any(k.startswith("features.") for k in sd):  # torchvision layout
+        from ..models.vgg import VGG19_CFG
+        pre_flatten = graph.nodes["flatten"].inputs[0]
+        spatial = graph.out_spec(pre_flatten).shape
+        return convert_state_dict(vgg_torch_mapping(VGG19_CFG, spatial),
+                                  sd, expected, "VGG19")
+    from .checkpoint import load_params
+    return load_params(path, expected)
+
+
+def load_pretrained_mobilenet_v2(path: str, graph: LayerGraph | None = None
+                                 ) -> dict[str, Any]:
+    """Load a MobileNetV2 checkpoint (torchvision or our flat layout)."""
+    if graph is None:
+        from ..models import mobilenet_v2
+        graph = mobilenet_v2()
+    expected = _expected_shapes(graph)
+    sd = _read_state_dict(path)
+    if any(k.startswith("features.") for k in sd):  # torchvision layout
+        return convert_state_dict(mobilenet_v2_torch_mapping(), sd,
+                                  expected, "MobileNetV2")
+    from .checkpoint import load_params
+    return load_params(path, expected)
+
+
+#: model-family name -> loader, for generic call sites (bench/CLI)
+PRETRAINED_LOADERS: dict[str, Callable] = {
+    "resnet50": load_pretrained_resnet50,
+    "vgg19": load_pretrained_vgg19,
+    "mobilenet_v2": load_pretrained_mobilenet_v2,
+}
+
+
+def load_pretrained(model: str, path: str,
+                    graph: LayerGraph | None = None) -> dict[str, Any]:
+    """Generic front door: ``load_pretrained("vgg19", path, graph)``."""
+    if model not in PRETRAINED_LOADERS:
+        raise ValueError(f"no pretrained loader for {model!r} "
+                         f"(have {sorted(PRETRAINED_LOADERS)})")
+    return PRETRAINED_LOADERS[model](path, graph)
