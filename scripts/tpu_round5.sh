@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 TPU measurement queue — fired at the first healthy tunnel
+# window (scripts/tpu_watch.sh touches /tmp/tpu_ok on recovery).
+#
+# Order is by VERDICT r4 priority: (2) decode + fold-bn re-measured on
+# TPU as committed artifacts; (4) the MFU-ceiling hunt (batch >= 256,
+# XLA flag sweep).  Every artifact is written to the repo root so a
+# wedge mid-queue still leaves the earlier results committed.
+set -u
+cd "${1:-/root/repo}"
+
+echo "[r5queue] $(date +%H:%M:%S) bench_decode -> DECODE_r05.json" >&2
+timeout 2400 python scripts/bench_decode.py > DECODE_r05.json.tmp \
+    2> /tmp/decode_r05.err \
+  && mv DECODE_r05.json.tmp DECODE_r05.json
+echo "[r5queue] decode rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[r5queue] $(date +%H:%M:%S) fold-bn comparison" >&2
+DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_TIMEOUT_S=150 \
+    timeout 1500 python bench.py --quick \
+    > /tmp/bench_nofold.json 2> /tmp/bench_nofold.err
+echo "[r5queue] nofold rc=$?" >&2
+DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_TIMEOUT_S=150 \
+    timeout 1500 python bench.py --quick --fold-bn \
+    > /tmp/bench_fold.json 2> /tmp/bench_fold.err
+echo "[r5queue] fold rc=$? $(date +%H:%M:%S)" >&2
+python - <<'EOF' > FOLDBN_r05.json
+import json
+rows = {}
+for tag, path in (("baseline", "/tmp/bench_nofold.json"),
+                  ("fold_bn", "/tmp/bench_fold.json")):
+    try:
+        with open(path) as f:
+            d = json.loads(f.read().strip().splitlines()[-1])
+        rows[tag] = {"pipeline_img_per_s": d["value"],
+                     "single_chip_best_img_per_s":
+                         d["single_chip_best_img_per_s"],
+                     "flops_per_img": d["flops_per_img"]}
+    except Exception as e:  # noqa: BLE001
+        rows[tag] = {"error": repr(e)[:200]}
+print(json.dumps({"metric": "resnet50_fold_bn_comparison", **rows}))
+EOF
+
+echo "[r5queue] $(date +%H:%M:%S) MFU hunt (batch sweep to 512)" >&2
+DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_TIMEOUT_S=150 \
+    timeout 2400 python bench.py --batches 32,128,256,512 \
+    --chunks 32,128 --microbatches 16,32 \
+    > BENCH_r05_builder.json.tmp 2> /tmp/bench_r05.err \
+  && mv BENCH_r05_builder.json.tmp BENCH_r05_builder.json
+echo "[r5queue] mfu rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[r5queue] $(date +%H:%M:%S) per-op profile" >&2
+timeout 1200 python scripts/profile_resnet_ops.py > PROFILE_OPS_r05.json.tmp \
+    2> /tmp/profile_ops.err \
+  && mv PROFILE_OPS_r05.json.tmp PROFILE_OPS_r05.json
+echo "[r5queue] done $(date +%H:%M:%S)" >&2
