@@ -424,6 +424,22 @@ def main():
                 acc[w] = p_small.run(x_acc)
                 del p_small
             denom = max(float(np.abs(y_ref).max()), 1e-6)
+            # task-level quality: does the wire change the *decision*?
+            # (r4 verdict: a raw logit delta alone can't say whether the
+            # quantization matters — top-1/top-5 agreement can)
+            ref_top1 = np.argmax(y_ref.reshape(-1, y_ref.shape[-1]), -1)
+            ref_top5 = np.argsort(
+                y_ref.reshape(-1, y_ref.shape[-1]), -1)[:, -5:]
+
+            def agree(logits):
+                flat = np.asarray(logits).reshape(-1, y_ref.shape[-1])
+                t1 = float((np.argmax(flat, -1) == ref_top1).mean())
+                t5 = float(np.mean([t in row for t, row in
+                                    zip(np.argmax(flat, -1), ref_top5)]))
+                return t1, t5
+
+            q_t1, q_t5 = agree(acc["int8"])
+            b_t1, b_t5 = agree(acc["buffer"])
             int8_row = {
                 "img_per_s": round(q_ips, 2),
                 "mfu": mfu(q_ips),
@@ -436,6 +452,10 @@ def main():
                     float(np.abs(acc["buffer"] - y_ref).max()), 5),
                 "rel_logit_err": round(
                     float(np.abs(acc["int8"] - y_ref).max()) / denom, 5),
+                "top1_agreement_vs_f32": round(q_t1, 4),
+                "top1_in_ref_top5": round(q_t5, 4),
+                "bf16_buffer_top1_agreement_vs_f32": round(b_t1, 4),
+                "bf16_buffer_top1_in_ref_top5": round(b_t5, 4),
             }
             log(f"pipeline int8 wire: {q_ips:.2f} img/s "
                 f"({int8_row['vs_buffer_wire']:.2f}x buffer wire), "
