@@ -562,6 +562,10 @@ class Defer:
         thread = threading.Thread(target=serve, daemon=True,
                                   name="defer-endpoint")
         thread.errors = errors  # inspectable post-join
+        # live redeploy: swap weights under the serving pipeline with no
+        # recompile and no client disruption (attribute swap is atomic;
+        # the chunk in flight finishes under the weights it started with)
+        thread.reweight = pipe.reweight
 
         def _stop():
             stop_ev.set()

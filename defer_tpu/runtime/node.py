@@ -289,6 +289,8 @@ class ChainDispatcher:
                             f"chain accepted no result for "
                             f"{self.timeout_s:.0f}s with {self.window} in "
                             f"flight — a stage is stuck")
+                    if rx_failed.is_set():
+                        return  # woken by the error path, not a result
                     send_frame(self._send_sock, np.asarray(x),
                                codec=self.codec)
                     sent[0] += 1

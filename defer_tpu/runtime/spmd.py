@@ -113,38 +113,10 @@ class SpmdPipeline:
         #: ranks (its shard shape equals the full leaf's shape) — the
         #: trainer needs this to sum tied-copy gradients across ranks
         self._wreplicated: list[list[bool]] = []
-        flats: list[list[np.ndarray]] = []  # [stage][tp_rank]
-        for s in self.stages:
-            rank_flats = []
-            full_shapes = None
-            if tp > 1:
-                full_shapes = [np.shape(l) for l in
-                               jax.tree.flatten(s.select_params(params))[0]]
-            for r in range(tp):
-                shard = (s.tp_shard_params(params, tp, r) if tp > 1
-                         else s.select_params(params))
-                leaves, treedef = jax.tree.flatten(shard)
-                if r == 0:
-                    self._wmeta.append(flatbuf.leaf_meta(leaves))
-                    self._wtreedef.append(treedef)
-                    self._wreplicated.append(
-                        [np.shape(l) == fs for l, fs
-                         in zip(leaves, full_shapes)]
-                        if full_shapes is not None
-                        else [True] * len(leaves))
-                rank_flats.append(flatbuf.pack_leaves(
-                    leaves, wdt,
-                    cast_fn=lambda a, _nm=s.name: self._to_wire(a, _nm)))
-            flats.append(rank_flats)
-        if tp > 1:
-            rows = [f for rf in flats for f in rf]
-            wbuf = flatbuf.stack_rows(rows, wdt).reshape(n, tp, -1)
-            wspec = P(STAGE_AXIS, MODEL_AXIS, None)
-        else:
-            wbuf = flatbuf.stack_rows([rf[0] for rf in flats], wdt)
-            wspec = P(STAGE_AXIS, None)
-        self._wspec = wspec
-        self._w = jax.device_put(wbuf, NamedSharding(self.mesh, wspec))
+        self._wspec = P(STAGE_AXIS, MODEL_AXIS, None) if tp > 1 \
+            else P(STAGE_AXIS, None)
+        self._w = jax.device_put(self._pack_wbuf(params, init=True),
+                                 NamedSharding(self.mesh, self._wspec))
 
         # --- homogeneous activation buffer sizing (shared geometry
         # helper: under wire="int8" the buffer pads to the quant block
@@ -210,6 +182,80 @@ class SpmdPipeline:
                 f"buffer, exact for |int| < 2**24) or keep such leaves "
                 f"out of the flat buffer")
         return cast
+
+    def _pack_wbuf(self, params, *, init: bool = False) -> np.ndarray:
+        """Pack ``params`` into the [N, (tp,) Pmax] flat weight buffer.
+
+        ``init=True`` (constructor) records per-stage leaf meta/treedefs;
+        ``init=False`` (reweight) validates the new leaves against the
+        recorded layout — same shapes or a loud error.
+        """
+        tp = self.tensor_parallel
+        n = self.num_stages
+        wdt = self.weight_dtype
+        flats: list[list[np.ndarray]] = []  # [stage][tp_rank]
+        for k, s in enumerate(self.stages):
+            rank_flats = []
+            full_shapes = None
+            if tp > 1:
+                full_shapes = [np.shape(l) for l in
+                               jax.tree.flatten(s.select_params(params))[0]]
+            for r in range(tp):
+                shard = (s.tp_shard_params(params, tp, r) if tp > 1
+                         else s.select_params(params))
+                leaves, treedef = jax.tree.flatten(shard)
+                if r == 0:
+                    if init:
+                        self._wmeta.append(flatbuf.leaf_meta(leaves))
+                        self._wtreedef.append(treedef)
+                        self._wreplicated.append(
+                            [np.shape(l) == fs for l, fs
+                             in zip(leaves, full_shapes)]
+                            if full_shapes is not None
+                            else [True] * len(leaves))
+                    else:
+                        # the compiled branches unflatten with the INIT-
+                        # recorded treedef/shapes/dtypes: all three must
+                        # match or the program would serve garbage
+                        if treedef != self._wtreedef[k]:
+                            raise ValueError(
+                                f"reweight: stage {s.name!r} param tree "
+                                f"structure differs from the deployed one")
+                        want = [(m[2], np.dtype(m[3])) for m
+                                in self._wmeta[k]]
+                        got = [(np.shape(l), np.asarray(l).dtype)
+                               for l in leaves]
+                        if want != got:
+                            raise ValueError(
+                                f"reweight: stage {s.name!r} leaves "
+                                f"{got} != deployed {want}")
+                rank_flats.append(flatbuf.pack_leaves(
+                    leaves, wdt,
+                    cast_fn=lambda a, _nm=s.name: self._to_wire(a, _nm)))
+            flats.append(rank_flats)
+        if tp > 1:
+            rows = [f for rf in flats for f in rf]
+            return flatbuf.stack_rows(rows, wdt).reshape(n, tp, -1)
+        return flatbuf.stack_rows([rf[0] for rf in flats], wdt)
+
+    def reweight(self, params) -> None:
+        """Install fresh weights into the live pipeline — no recompile.
+
+        The SPMD analogue of the chain's weights-only re-push
+        (``ChainDispatcher.reweight``): the new params (same graph, same
+        leaf shapes) are packed into a fresh flat buffer and placed with
+        the existing sharding; the compiled chunk program is reused as-is.
+        Microbatches still inside the pipe run their REMAINING stages
+        under the new weights (mixed-generation execution) — call
+        ``flush()`` first when a clean cut matters.
+        """
+        wbuf = self._pack_wbuf(params, init=False)
+        if wbuf.shape != self._w.shape:
+            raise ValueError(
+                f"reweight: packed buffer {wbuf.shape} != deployed "
+                f"{self._w.shape} (stage boundaries changed?)")
+        self._w = jax.device_put(
+            wbuf, NamedSharding(self.mesh, self._wspec))
 
     def _make_branch(self, k: int):
         stage = self.stages[k]

@@ -77,6 +77,33 @@ def test_operator_stop_terminates_undersubscribed_endpoint():
     assert not thread.is_alive()
 
 
+def test_live_reweight_between_clients():
+    """thread.reweight swaps serving weights with no recompile: a second
+    client sees the new model's outputs over the same endpoint."""
+    g, params = _model()
+    defer = Defer(config=DeferConfig(microbatch=1, chunk=4))
+    address, thread = defer.serve_endpoint(g, params, num_stages=4,
+                                           max_clients=2)
+    rng = np.random.default_rng(4)
+    xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+          for _ in range(3)]
+    c1 = TensorClient(*address)
+    out1 = c1.infer_stream(xs)
+    c1.close()
+    params2 = jax.tree.map(lambda a: a * 1.5, params)
+    thread.reweight(params2)
+    c2 = TensorClient(*address)
+    out2 = c2.infer_stream(xs)
+    c2.close()
+    thread.join(timeout=60)
+    fwd = jax.jit(g.apply)
+    for x, y1, y2 in zip(xs, out1, out2):
+        np.testing.assert_allclose(y1, np.asarray(fwd(params, x)),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(y2, np.asarray(fwd(params2, x)),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_client_death_then_reconnect():
     """A client that dies mid-stream (no END) is discarded; a fresh client
     connecting afterwards is served normally over the same pipeline."""
