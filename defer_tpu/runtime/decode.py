@@ -188,16 +188,10 @@ class PipelinedDecoder:
         # no per-step downcast materialization
         wdt = np.dtype(jnp.bfloat16) if self.compute_dtype == jnp.bfloat16 \
             else np.float32
-        self._wmeta, self._wtreedef, flats = [], [], []
-        for names in stage_param_names:
-            sub = {nm: params[nm] for nm in names}
-            leaves, treedef = jax.tree.flatten(sub)
-            leaves = [np.asarray(l).astype(wdt) for l in leaves]
-            self._wmeta.append(flatbuf.leaf_meta(leaves))
-            self._wtreedef.append(treedef)
-            flats.append(flatbuf.pack_leaves(leaves, wdt))
+        self._wdt = wdt
+        self._wmeta, self._wtreedef = [], []
         self._w = jax.device_put(
-            flatbuf.stack_rows(flats, wdt),
+            self._pack_wbuf(params, init=True),
             NamedSharding(self.mesh, P(STAGE_AXIS, None)))
 
         # group axis is n+1: slot n is the scratch group that pipelined
@@ -221,6 +215,43 @@ class PipelinedDecoder:
         self._init_fn = None  # cached jitted state initializer
 
     # ------------------------------------------------------------------
+
+    def _pack_wbuf(self, params, *, init: bool = False) -> np.ndarray:
+        """Pack ``params`` into the [N, Pmax] flat weight buffer; with
+        ``init=False`` (reweight) the new leaves must match the deployed
+        treedef/shapes/dtypes exactly (the compiled programs unflatten
+        with the init-recorded layout)."""
+        wdt = self._wdt
+        flats = []
+        for s, names in enumerate(self._stage_param_names):
+            sub = {nm: params[nm] for nm in names}
+            leaves, treedef = jax.tree.flatten(sub)
+            # meta records PRE-cast shapes/dtypes so reweight validation
+            # catches dtype drift before the blind wire-dtype cast
+            if init:
+                self._wmeta.append(flatbuf.leaf_meta(leaves))
+                self._wtreedef.append(treedef)
+            else:
+                flatbuf.check_layout(leaves, treedef, self._wmeta[s],
+                                     self._wtreedef[s],
+                                     f"reweight: stage {s}")
+            flats.append(flatbuf.pack_leaves(
+                [np.asarray(l).astype(wdt) for l in leaves], wdt))
+        return flatbuf.stack_rows(flats, wdt)
+
+    def reweight(self, params) -> None:
+        """Install fresh weights — no recompile, caches untouched.
+
+        The decode analogue of ``SpmdPipeline.reweight``: compiled decode
+        and prefill programs read the flat buffer as an argument, so a
+        buffer swap redeploys (e.g. after further finetuning) without
+        invalidating ``_decode_fns``/``_prefill_fns``.  Call between
+        ``generate`` rounds — an in-flight generation keeps the weights
+        it started with only up to its current dispatch boundary.
+        """
+        self._w = jax.device_put(
+            self._pack_wbuf(params, init=False),
+            NamedSharding(self.mesh, P(STAGE_AXIS, None)))
 
     def _stage_params(self, s: int, w_local: jax.Array):
         return flatbuf.unpack_leaves(w_local, self._wmeta[s],

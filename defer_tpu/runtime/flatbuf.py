@@ -33,6 +33,26 @@ def leaf_meta(leaves: Sequence[np.ndarray]) -> list[LeafMeta]:
     return meta
 
 
+def check_layout(leaves: Sequence[np.ndarray], treedef,
+                 want_meta: Sequence[LeafMeta], want_treedef,
+                 what: str) -> None:
+    """Validate PRE-cast leaves + treedef against a deployed row layout.
+
+    The one rule both engines' ``reweight`` paths share: the compiled
+    programs unflatten with the init-recorded treedef/shapes, and a
+    silent dtype change would blind-cast values — so structure, shapes,
+    AND original dtypes must match or we raise before touching the
+    deployed buffer.
+    """
+    if treedef != want_treedef:
+        raise ValueError(
+            f"{what}: param tree structure differs from the deployed one")
+    want = [(m[2], np.dtype(m[3])) for m in want_meta]
+    got = [(np.shape(l), np.asarray(l).dtype) for l in leaves]
+    if want != got:
+        raise ValueError(f"{what}: leaves {got} != deployed {want}")
+
+
 def pack_leaves(leaves: Sequence[np.ndarray], wire_dtype,
                 cast_fn: Callable[[np.ndarray], np.ndarray] | None = None,
                 ) -> np.ndarray:

@@ -217,18 +217,9 @@ class SpmdPipeline:
                         # the compiled branches unflatten with the INIT-
                         # recorded treedef/shapes/dtypes: all three must
                         # match or the program would serve garbage
-                        if treedef != self._wtreedef[k]:
-                            raise ValueError(
-                                f"reweight: stage {s.name!r} param tree "
-                                f"structure differs from the deployed one")
-                        want = [(m[2], np.dtype(m[3])) for m
-                                in self._wmeta[k]]
-                        got = [(np.shape(l), np.asarray(l).dtype)
-                               for l in leaves]
-                        if want != got:
-                            raise ValueError(
-                                f"reweight: stage {s.name!r} leaves "
-                                f"{got} != deployed {want}")
+                        flatbuf.check_layout(
+                            leaves, treedef, self._wmeta[k],
+                            self._wtreedef[k], f"reweight: stage {s.name!r}")
                 rank_flats.append(flatbuf.pack_leaves(
                     leaves, wdt,
                     cast_fn=lambda a, _nm=s.name: self._to_wire(a, _nm)))

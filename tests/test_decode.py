@@ -290,6 +290,40 @@ def test_defer_score(model, prompt):
     assert (ppl > 0).all() and np.allclose(ppl, np.exp(-lp / 9), rtol=1e-6)
 
 
+def test_decoder_reweight_no_recompile(model, prompt):
+    """Weights-only re-push on the decode engine: fresh params install
+    into the live flat buffer, compiled decode programs are reused, and
+    generations match the single-device oracle under the new weights."""
+    graph, params = model
+    dec = PipelinedDecoder(graph, params, num_stages=4, microbatch=2,
+                           max_len=MAX_LEN)
+    a = dec.generate(prompt, 6)
+    compiled_before = len(dec._decode_fns) + len(dec._prefill_fns)
+
+    params2 = jax.tree.map(lambda x: x * 1.1, params)
+    dec.reweight(params2)
+    b = dec.generate(prompt, 6)
+    np.testing.assert_array_equal(
+        b, incremental_greedy(graph, params2, prompt, 5 + 6, MAX_LEN))
+    assert len(dec._decode_fns) + len(dec._prefill_fns) == compiled_before
+
+    dec.reweight(params)  # originals restore the original generation
+    np.testing.assert_array_equal(dec.generate(prompt, 6), a)
+
+    bad = dict(params2)
+    bad["lm_head"] = {"w": np.zeros((2, 2), np.float32),
+                      "b": np.zeros((2,), np.float32)}
+    with pytest.raises(ValueError, match="reweight"):
+        dec.reweight(bad)
+    # dtype drift with matching shapes must also be refused: the buffer
+    # would otherwise blind-cast the values
+    drift = dict(params)
+    drift["lm_head"] = jax.tree.map(
+        lambda a: np.asarray(a).astype(np.int32), params["lm_head"])
+    with pytest.raises(ValueError, match="reweight"):
+        dec.reweight(drift)
+
+
 def test_defer_score_bucketed_short_sequence(model):
     """Scoring T=6 under a 24-token graph routes through a power-of-two
     bucketed pipeline (8 positions, not 24) with identical results."""
