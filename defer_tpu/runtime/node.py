@@ -89,6 +89,8 @@ class StageNode:
             self.prog = load_stage_program(artifact)
         self.next_hop = _parse_hostport(next_hop) if next_hop else None
         self.codec = codec
+        self.processed = 0    # tensors relayed, lifetime
+        self.reweights = 0    # weights-only re-pushes accepted
 
     @property
     def manifest(self):
@@ -120,7 +122,22 @@ class StageNode:
             if self.prog is None:
                 raise ValueError("reweight before deploy")
             self.prog.reweight(recv_expect(conn, K_BYTES))
+            self.reweights += 1
             send_ack(conn)
+            return True
+        if cmd == "stats":
+            # chain observability: what this node is and has done — the
+            # per-node view the reference never had (SURVEY §5 metrics)
+            m = self.manifest
+            send_ctrl(conn, {
+                "stage": None if m is None else m["index"],
+                "name": None if m is None else m["name"],
+                "processed": self.processed,
+                "reweights": self.reweights,
+                "codec": self.codec,
+                "next": None if self.next_hop is None
+                else f"{self.next_hop[0]}:{self.next_hop[1]}",
+            })
             return True
         raise ValueError(f"unknown control command {msg!r}")
 
@@ -205,6 +222,8 @@ class StageNode:
                         f"stage {self.manifest['index']} expects sample "
                         f"shape {want}, got {tuple(value.shape[1:])}")
                 y = np.asarray(self.prog(value))
+                self.processed += 1  # before the send: a stats query can
+                #   race the relay of the final tensor otherwise
                 send_frame(out, y, codec=self.codec)
                 n += 1
                 streamed = True
@@ -383,6 +402,22 @@ class ChainDispatcher:
                 send_end(s)
             finally:
                 s.close()
+
+    def stats(self, node_addrs: Sequence[str]) -> list[dict]:
+        """Per-node chain observability: query every node's stats control
+        endpoint (stage identity, tensors processed, reweights, topology)
+        — works mid-stream thanks to thread-per-connection nodes."""
+        out = []
+        for addr in node_addrs:
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=self.timeout_s)
+            try:
+                send_ctrl(s, {"cmd": "stats"})
+                out.append(recv_expect(s, K_CTRL))
+                send_end(s)
+            finally:
+                s.close()
+        return out
 
     def _recv_tensor(self) -> np.ndarray:
         """One in-order result frame; loud protocol check (not an assert:

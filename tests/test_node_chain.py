@@ -120,9 +120,14 @@ def test_in_band_reweight_over_socket(tiny):
     xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
           for _ in range(3)]
     out1 = disp.stream(xs)
+    st = disp.stats(addrs)  # mid-stream observability
+    assert [s["stage"] for s in st] == [0, 1]
+    assert all(s["processed"] == 3 and s["reweights"] == 0 for s in st)
     params2 = jax.tree.map(lambda a: a * 0.5, params)
     disp.reweight(stages, params2, addrs)
     out2 = disp.stream(xs)
+    st2 = disp.stats(addrs)
+    assert all(s["processed"] == 6 and s["reweights"] == 1 for s in st2)
     disp.close()
     for t in threads:
         t.join(timeout=30)
