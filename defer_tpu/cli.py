@@ -51,7 +51,19 @@ def cmd_partition(args):
 
     graph = _get_model(args.model)
     cuts = args.cuts.split(",") if args.cuts else None
-    stages = partition(graph, cuts, num_stages=args.stages)
+    if cuts is None and args.balance == "measured":
+        # latency-balanced auto-cuts: time every op on THIS backend and
+        # snap quantiles of measured (not analytic) cost to valid cuts
+        if args.stages is None:
+            raise SystemExit("--balance measured requires --stages")
+        from .graph.analysis import auto_cut_points
+        from .utils.profiling import measured_node_costs
+        params = graph.init(jax.random.key(0))
+        costs = measured_node_costs(graph, params, batch=args.batch)
+        cuts = auto_cut_points(graph, args.stages, costs=costs)
+        print(f"measured-balanced cuts: {cuts}")
+    stages = partition(graph, cuts, num_stages=args.stages
+                       if cuts is None else None)
     print(f"{graph.name}: {len(graph.nodes)} nodes, "
           f"{len(valid_cut_points(graph))} valid cut points")
     for s in stages:
@@ -277,6 +289,12 @@ def main(argv=None):
     p.add_argument("--model", required=True)
     p.add_argument("--stages", type=int)
     p.add_argument("--cuts")
+    p.add_argument("--balance", choices=["flops", "measured"],
+                   default="flops",
+                   help="auto-cut cost model: analytic FLOPs, or per-op "
+                        "latency measured on this backend")
+    p.add_argument("--batch", type=int, default=1,
+                   help="batch size for --balance measured timing")
     p.add_argument("--dot", help="write a DOT graph with stage coloring")
     p.add_argument("--summary", action="store_true")
 

@@ -55,12 +55,20 @@ def total_flops(graph: LayerGraph) -> int:
     return sum(node_flops(graph, n) for n in graph.topo_order)
 
 
-def auto_cut_points(graph: LayerGraph, num_stages: int) -> list[str]:
-    """Pick ``num_stages - 1`` valid cuts balancing per-stage FLOPs.
+def auto_cut_points(graph: LayerGraph, num_stages: int,
+                    costs: dict[str, float] | None = None) -> list[str]:
+    """Pick ``num_stages - 1`` valid cuts balancing per-stage cost.
 
     This is the principled version of DEFER's hand-listed
     ``["add_2", "add_4", ...]`` (reference test/test.py:18): cumulative cost
     quantiles snapped to the nearest valid articulation point.
+
+    ``costs`` maps node name -> per-node cost; default is the analytic
+    FLOP model.  Pass measured per-node seconds (e.g. from
+    ``utils.profiling.measured_node_costs``) to balance on what the
+    hardware actually does — the FLOP model under-weights
+    bandwidth-bound ops (pools, norms, cheap convs at high resolution),
+    so measured balancing typically moves cuts earlier in CNNs.
     """
     if num_stages < 1:
         raise ValueError("num_stages must be >= 1")
@@ -73,25 +81,35 @@ def auto_cut_points(graph: LayerGraph, num_stages: int) -> list[str]:
             f"cannot make {num_stages} stages")
 
     order = graph.topo_order
+    if costs is not None:
+        missing = [n for n in order if n not in costs]
+        if missing:
+            raise ValueError(f"costs missing nodes: {missing[:5]}...")
     cum = {}
     acc = 0
     for name in order:
-        acc += node_flops(graph, name)
+        acc += costs[name] if costs is not None else node_flops(graph, name)
         cum[name] = acc
-    total = max(acc, 1)
+    # guard ONLY exactly-zero totals: max(acc, 1) would clamp sub-1.0
+    # measured-seconds sums to 1 and push every quantile target past the
+    # end of the curve (collapsing all cuts to the tail)
+    total = acc if acc > 0 else 1
 
     chosen: list[str] = []
     available = list(cuts)
     for j in range(1, num_stages):
         target = total * j / num_stages
-        # nearest still-available cut by cumulative cost, keeping order
-        best = min(available, key=lambda n: abs(cum[n] - target))
+        # nearest still-available cut by cumulative cost, keeping order —
+        # restricted so enough candidates REMAIN for the later cuts (a
+        # greedy pick near the tail could otherwise exhaust the pool;
+        # skewed measured-cost maps hit this where the smooth FLOP model
+        # rarely did)
+        remaining_after = num_stages - 1 - j
+        cands = available[: len(available) - remaining_after]
+        best = min(cands, key=lambda n: abs(cum[n] - target))
         chosen.append(best)
         # drop this cut and everything before it to preserve ordering
         available = available[available.index(best) + 1:]
-        if not available and j < num_stages - 1:
-            raise ValueError("ran out of cut points while balancing; "
-                             f"got {len(chosen)} of {num_stages - 1}")
     return chosen
 
 

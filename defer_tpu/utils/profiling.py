@@ -107,6 +107,55 @@ def pipeline_window_seconds(pipe, inputs, *, inflight: int = 2,
     return run_window(m) / m
 
 
+def measured_node_costs(graph, params, *, batch: int = 1,
+                        compute_dtype=None, reps: int = 5,
+                        warmup: int = 1) -> dict[str, float]:
+    """Per-node measured seconds for every node of ``graph`` — the
+    empirical cost map for latency-balanced partitioning
+    (``graph.analysis.auto_cut_points(g, n, costs=...)``).
+
+    Each op is jitted and timed standalone at ``batch`` (min over
+    ``reps`` dispatch+sync rounds after ``warmup``).  Standalone per-op
+    timing ignores XLA fusion across ops, so the ABSOLUTE numbers
+    overstate a fused stage — but partitioning only needs the RELATIVE
+    weights, where measurement beats the FLOP model for bandwidth-bound
+    ops (pools, norms, elementwise) that the analytic model scores near
+    zero.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    costs: dict[str, float] = {}
+    for name in graph.topo_order:
+        node = graph.nodes[name]
+        in_specs = [graph.out_spec(i) for i in node.inputs]
+        xs = []
+        for s in in_specs:
+            dt = s.dtype
+            if compute_dtype is not None and jnp.issubdtype(
+                    dt, jnp.floating):
+                dt = compute_dtype
+            xs.append(jnp.zeros((batch,) + s.shape, dt))
+        p = params.get(name)
+        if compute_dtype is not None and p is not None:
+            p = jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a, p)
+        fn = jax.jit(lambda pp, *xx, _op=node.op: _op.apply(pp, *xx))
+        for _ in range(warmup):
+            jax.block_until_ready(fn(p, *xs))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(p, *xs))
+            best = min(best, _time.perf_counter() - t0)
+        costs[name] = best
+    return costs
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture an XLA/TPU profiler trace (view with tensorboard/xprof)."""

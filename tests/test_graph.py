@@ -85,6 +85,54 @@ def test_auto_cut_points_balanced():
         auto_cut_points(g, 50)  # more stages than cut points
 
 
+def test_auto_cut_points_with_measured_costs():
+    """costs= overrides the FLOP model: equal-FLOP chains cut evenly by
+    default, but concentrated measured cost pulls every cut toward it."""
+    b = GraphBuilder("chain")
+    x = b.input((16,))
+    for i in range(10):
+        x = b.add(ops.Dense(16), x, name=f"fc{i}")
+    g = b.build()
+    even = auto_cut_points(g, 2)
+    # all the real cost lives in the last two nodes: the midpoint cut
+    # must move to just before them
+    costs = {n: 1.0 for n in g.topo_order}
+    costs["fc8"] = costs["fc9"] = 100.0
+    skewed = auto_cut_points(g, 2, costs=costs)
+    assert g.topo_order.index(skewed[0]) > g.topo_order.index(even[0])
+    # cum costs: fc7=8, fc8=108, fc9=208; half-total=104 -> cut after fc8
+    # (stages 108/100 — the balanced split the FLOP model can't see)
+    assert skewed == ["fc8"]
+    # a costs map that misses nodes is refused loudly
+    with pytest.raises(ValueError, match="missing"):
+        auto_cut_points(g, 2, costs={"fc0": 1.0})
+    # sub-1.0 totals (measured SECONDS sum well below 1) must balance
+    # identically to the same relative costs scaled up — the old
+    # max(total, 1) clamp collapsed all cuts to the graph tail
+    tiny = {n: 1e-5 for n in g.topo_order}
+    assert auto_cut_points(g, 2, costs=tiny) == \
+        auto_cut_points(g, 2, costs={n: 10.0 for n in g.topo_order})
+    assert auto_cut_points(g, 4, costs=tiny) == \
+        auto_cut_points(g, 4, costs={n: 10.0 for n in g.topo_order})
+
+
+def test_measured_node_costs_integrates():
+    """measured_node_costs produces a usable cost map for every node and
+    auto_cut_points accepts it end to end (timings real, on this CPU)."""
+    from defer_tpu.utils.profiling import measured_node_costs
+    b = GraphBuilder("chain")
+    x = b.input((16,))
+    for i in range(6):
+        x = b.add(ops.Dense(16), x, name=f"fc{i}")
+    g = b.build()
+    params = g.init(jax.random.key(1))
+    costs = measured_node_costs(g, params, reps=2, warmup=1)
+    assert set(costs) == set(g.topo_order)
+    assert all(v > 0 for v in costs.values())
+    cuts = auto_cut_points(g, 3, costs=costs)
+    assert len(cuts) == 2
+
+
 def test_flops_and_viz():
     g = diamond_graph()
     assert node_flops(g, "a") == 2 * 4 * 8
