@@ -51,6 +51,9 @@ def cmd_partition(args):
 
     graph = _get_model(args.model)
     cuts = args.cuts.split(",") if args.cuts else None
+    if cuts is not None and args.balance == "measured":
+        raise SystemExit("--cuts and --balance measured conflict: "
+                         "explicit cuts leave nothing to balance")
     if cuts is None and args.balance == "measured":
         # latency-balanced auto-cuts: time every op on THIS backend and
         # snap quantiles of measured (not analytic) cost to valid cuts
@@ -256,6 +259,7 @@ def cmd_generate(args):
     dec = PipelinedDecoder(graph, params, num_stages=args.stages,
                            microbatch=args.microbatch,
                            kv_cache=args.kv_cache,
+                           weight_dtype=args.weight_dtype or None,
                            beam_width=args.beam)
     rng = np.random.default_rng(args.seed)
     b = args.stages * (args.microbatch // args.beam)
@@ -274,6 +278,7 @@ def cmd_generate(args):
         "batch": b, "prompt_len": args.prompt_len,
         "new_tokens": args.new_tokens, "prefill": args.prefill,
         "kv_cache": args.kv_cache, "beam": args.beam,
+        "weight_dtype": args.weight_dtype or "compute",
         "tokens_per_s": round(b * args.new_tokens / dt, 2),
         "first_row": toks[0].tolist(),
     }))
@@ -368,6 +373,10 @@ def main(argv=None):
     g.add_argument("--kv-cache", default="buffer",
                    choices=["buffer", "int8"],
                    help="int8: quantized KV cache (~1 byte/value reads)")
+    g.add_argument("--weight-dtype", default="",
+                   choices=["", "int8"],
+                   help="int8: W8A16 weight-only quantization "
+                        "(channel-wise scales, dequant fused per stage)")
     g.add_argument("--beam", type=int, default=1,
                    help="beam width (must divide --microbatch)")
 

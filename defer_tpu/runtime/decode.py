@@ -110,6 +110,7 @@ class PipelinedDecoder:
         microbatch: int = 1,
         compute_dtype=None,
         kv_cache: str = "buffer",
+        weight_dtype: str | None = None,
         beam_width: int = 1,
     ):
         self.graph = graph
@@ -125,6 +126,15 @@ class PipelinedDecoder:
             raise ValueError(
                 f"kv_cache must be 'buffer' or 'int8', got {kv_cache!r}")
         self.kv_cache = kv_cache
+        if weight_dtype not in (None, "int8"):
+            raise ValueError(
+                f"weight_dtype must be None or 'int8', got {weight_dtype!r}")
+        #: W8A16: weights live int8 in HBM with channel-wise (last-axis)
+        #: f32 scales, dequantized inside each stage branch.  Decode is
+        #: HBM-bandwidth-bound (every step streams all weights), so int8
+        #: halves the dominant traffic vs bf16.  1-D leaves (LN scales,
+        #: biases) get per-element scales — exactly invertible.
+        self.weight_quant = weight_dtype == "int8"
         if beam_width < 1 or mb % beam_width:
             raise ValueError(
                 f"beam_width={beam_width} must be >= 1 and divide "
@@ -190,9 +200,13 @@ class PipelinedDecoder:
             else np.float32
         self._wdt = wdt
         self._wmeta, self._wtreedef = [], []
+        self._smeta: list[list[tuple[int, int]]] = []  # per-leaf scale slots
         self._w = jax.device_put(
             self._pack_wbuf(params, init=True),
             NamedSharding(self.mesh, P(STAGE_AXIS, None)))
+        #: shard_map spec for the weight argument (pytree under W8A16)
+        self._wspec_tree = jax.tree.map(lambda _: P(STAGE_AXIS, None),
+                                        self._w)
 
         # group axis is n+1: slot n is the scratch group that pipelined
         # prefill's warmup/drain bubbles write into (the group-axis twin of
@@ -222,7 +236,7 @@ class PipelinedDecoder:
         treedef/shapes/dtypes exactly (the compiled programs unflatten
         with the init-recorded layout)."""
         wdt = self._wdt
-        flats = []
+        flats, qflats, sflats = [], [], []
         for s, names in enumerate(self._stage_param_names):
             sub = {nm: params[nm] for nm in names}
             leaves, treedef = jax.tree.flatten(sub)
@@ -235,9 +249,21 @@ class PipelinedDecoder:
                 flatbuf.check_layout(leaves, treedef, self._wmeta[s],
                                      self._wtreedef[s],
                                      f"reweight: stage {s}")
-            flats.append(flatbuf.pack_leaves(
-                [np.asarray(l).astype(wdt) for l in leaves], wdt))
-        return flatbuf.stack_rows(flats, wdt)
+            if not self.weight_quant:
+                flats.append(flatbuf.pack_leaves(
+                    [np.asarray(l).astype(wdt) for l in leaves], wdt))
+                continue
+            # W8A16: shared layout (flatbuf.quantize_leaves) — int8 values
+            # at leaf_meta's element offsets + a parallel f32 scale row
+            q_row, s_row, smeta = flatbuf.quantize_leaves(leaves)
+            if init:
+                self._smeta.append(smeta)
+            qflats.append(q_row)
+            sflats.append(s_row)
+        if not self.weight_quant:
+            return flatbuf.stack_rows(flats, wdt)
+        return {"q": flatbuf.stack_rows(qflats, np.dtype(np.int8)),
+                "s": flatbuf.stack_rows(sflats, np.dtype(np.float32))}
 
     def reweight(self, params) -> None:
         """Install fresh weights — no recompile, caches untouched.
@@ -253,9 +279,13 @@ class PipelinedDecoder:
             self._pack_wbuf(params, init=False),
             NamedSharding(self.mesh, P(STAGE_AXIS, None)))
 
-    def _stage_params(self, s: int, w_local: jax.Array):
-        return flatbuf.unpack_leaves(w_local, self._wmeta[s],
-                                     self._wtreedef[s])
+    def _stage_params(self, s: int, w_local):
+        if not self.weight_quant:
+            return flatbuf.unpack_leaves(w_local, self._wmeta[s],
+                                         self._wtreedef[s])
+        return flatbuf.unpack_quant_leaves(
+            w_local["q"], w_local["s"], self._wmeta[s], self._smeta[s],
+            self._wtreedef[s], self.compute_dtype)
 
     def _slice_lg(self, arr, l, g):
         """[Lmax, N+1, ...] cache entry -> the (block l, group g) item."""
@@ -518,7 +548,7 @@ class PipelinedDecoder:
         num_steps = 2 * n - 1  # n groups through n stages, pipelined
 
         def device_prefill(w, prompt, seed, temp, caches):
-            w_l = w[0]
+            w_l = jax.tree.map(lambda x: x[0], w)
             idx = lax.axis_index(STAGE_AXIS)
             a0 = jnp.zeros((mb, plen * d), jnp.float32)
             local = jax.tree.map(lambda c: c[0], caches)
@@ -538,7 +568,7 @@ class PipelinedDecoder:
         state = self._state_specs()
         fn = jax.shard_map(
             device_prefill, mesh=self.mesh,
-            in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
+            in_specs=(self._wspec_tree, P(None, None, None), P(), P(),
                       state),
             out_specs=(state, P(STAGE_AXIS, None, None)),
             check_vma=False,
@@ -587,7 +617,7 @@ class PipelinedDecoder:
 
         def device_decode(w, prompt, plen, t0, t_stop, seed, temp,
                           first_ids, first_pos, start, a, caches):
-            w_l = w[0]
+            w_l = jax.tree.map(lambda x: x[0], w)
             idx = lax.axis_index(STAGE_AXIS)
             local = jax.tree.map(lambda c: c[0], caches)
 
@@ -624,7 +654,7 @@ class PipelinedDecoder:
             else P(STAGE_AXIS, None, None)
         fn = jax.shard_map(
             device_decode, mesh=self.mesh,
-            in_specs=(P(STAGE_AXIS, None), P(None, None, None), P(), P(),
+            in_specs=(self._wspec_tree, P(None, None, None), P(), P(),
                       P(), P(), P(), P(None, None), P(), P(),
                       P(STAGE_AXIS, None, None), state),
             out_specs=(P(STAGE_AXIS, None, None), state, out_ids),

@@ -174,7 +174,8 @@ class Defer:
 
     def generate(self, graph, params, prompt_ids, max_new_tokens: int,
                  *, num_stages: int | None = None, max_len: int | None = None,
-                 kv_cache: str = "buffer", **sample_kw):
+                 kv_cache: str = "buffer", weight_dtype: str | None = None,
+                 **sample_kw):
         """Pipelined autoregressive generation (decoder graphs).
 
         Convenience over :class:`~defer_tpu.runtime.decode.PipelinedDecoder`
@@ -187,7 +188,7 @@ class Defer:
         if num_stages is None:
             num_stages = self._default_num_stages()
         key = (id(graph), id(params), num_stages, max_len, kv_cache,
-               self._cfg_cache_key())
+               weight_dtype, self._cfg_cache_key())
         hit = self._decoder_cache.get(key)
         if hit is not None and hit[0] is graph and hit[1] is params:
             dec = hit[2]
@@ -195,7 +196,8 @@ class Defer:
             dec = PipelinedDecoder(
                 graph, params, num_stages=num_stages, mesh=self.mesh,
                 microbatch=self.config.microbatch, max_len=max_len,
-                compute_dtype=self.config.compute_dtype, kv_cache=kv_cache)
+                compute_dtype=self.config.compute_dtype, kv_cache=kv_cache,
+                weight_dtype=weight_dtype)
             if len(self._decoder_cache) >= self._CACHE_MAX:
                 self._decoder_cache.pop(next(iter(self._decoder_cache)))
             self._decoder_cache[key] = (graph, params, dec)

@@ -108,24 +108,28 @@ def pipeline_window_seconds(pipe, inputs, *, inflight: int = 2,
 
 
 def measured_node_costs(graph, params, *, batch: int = 1,
-                        compute_dtype=None, reps: int = 5,
-                        warmup: int = 1) -> dict[str, float]:
+                        compute_dtype=None, k: int = 32,
+                        reps: int = 3) -> dict[str, float]:
     """Per-node measured seconds for every node of ``graph`` — the
     empirical cost map for latency-balanced partitioning
     (``graph.analysis.auto_cut_points(g, n, costs=...)``).
 
-    Each op is jitted and timed standalone at ``batch`` (min over
-    ``reps`` dispatch+sync rounds after ``warmup``).  Standalone per-op
-    timing ignores XLA fusion across ops, so the ABSOLUTE numbers
-    overstate a fused stage — but partitioning only needs the RELATIVE
-    weights, where measurement beats the FLOP model for bandwidth-bound
-    ops (pools, norms, elementwise) that the analytic model scores near
-    zero.
+    Each op runs ``k`` iterations fused in ONE ``lax.scan`` dispatch
+    (min over ``reps`` rounds, divided by ``k``) — per-call dispatch+sync
+    timing would put the SAME floor under every node (tens of µs on a
+    local backend, ~64 ms/sync through the axon tunnel once a large
+    program has run), flattening the relative weights toward uniform and
+    silently defeating the balancing.  Standalone per-op timing still
+    ignores cross-op XLA fusion, so ABSOLUTE numbers overstate a fused
+    stage; partitioning only needs the RELATIVE weights, where
+    measurement beats the FLOP model for bandwidth-bound ops (pools,
+    norms, elementwise) that the analytic model scores near zero.
     """
     import time as _time
 
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     costs: dict[str, float] = {}
     for name in graph.topo_order:
@@ -144,15 +148,31 @@ def measured_node_costs(graph, params, *, batch: int = 1,
                 lambda a: a.astype(compute_dtype)
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
                 else a, p)
-        fn = jax.jit(lambda pp, *xx, _op=node.op: _op.apply(pp, *xx))
-        for _ in range(warmup):
-            jax.block_until_ready(fn(p, *xs))
+
+        def scan_op(pp, xx, ts, _op=node.op):
+            # perturb the first input per step so the op stays live in
+            # the loop (an invariant body would be hoisted out entirely)
+            def body(c, t):
+                if jnp.issubdtype(xx[0].dtype, jnp.floating):
+                    x0 = xx[0] + (t * 1e-7).astype(xx[0].dtype)
+                else:  # int ids: alternate +0/+1, stays a valid index set
+                    x0 = xx[0] + (t.astype(jnp.int32) % 2).astype(
+                        xx[0].dtype)
+                y = _op.apply(pp, x0, *xx[1:])
+                return c + y.astype(jnp.float32).sum(), None
+
+            s, _ = lax.scan(body, jnp.float32(0), ts)
+            return s
+
+        fn = jax.jit(scan_op)
+        ts = jnp.arange(k, dtype=jnp.float32)
+        jax.block_until_ready(fn(p, xs, ts))  # compile + warm
         best = float("inf")
         for _ in range(reps):
             t0 = _time.perf_counter()
-            jax.block_until_ready(fn(p, *xs))
+            jax.block_until_ready(fn(p, xs, ts))
             best = min(best, _time.perf_counter() - t0)
-        costs[name] = best
+        costs[name] = best / k
     return costs
 
 

@@ -83,20 +83,25 @@ def main():
     # call compiles, the timed second call is dispatch-only
     token_chunk = 32
     sweep = {}
-    variants = [("", graph, params, "buffer")]
+    variants = [("", graph, params, "buffer", None)]
     if on_tpu:
         variants.append((f"_gqa{gqa_kv}kv", graph_gqa, params_gqa,
-                         "buffer"))
-        variants.append(("_int8kv", graph, params, "int8"))
+                         "buffer", None))
+        variants.append(("_int8kv", graph, params, "int8", None))
+        # W8A16: int8 weights halve the dominant HBM stream vs bf16 —
+        # the decode-side memory-bandwidth lever
+        variants.append(("_w8", graph, params, "buffer", "int8"))
+        variants.append(("_w8_int8kv", graph, params, "int8", "int8"))
     for mb in mbs:
-        for vtag, vgraph, vparams, vcache in variants:
+        for vtag, vgraph, vparams, vcache, vwq in variants:
             for use_prefill in ((False, True) if on_tpu else (False,)):
                 tag = f"mb{mb}{vtag}" + ("_prefill" if use_prefill else "")
                 try:
                     dec = PipelinedDecoder(vgraph, vparams, num_stages=1,
                                            microbatch=mb, max_len=max_len,
                                            compute_dtype=cd,
-                                           kv_cache=vcache)
+                                           kv_cache=vcache,
+                                           weight_dtype=vwq)
                     prompt = rng.integers(0, vocab,
                                           size=(mb, plen)).astype(np.int32)
                     kw = dict(max_new_tokens=new, token_chunk=token_chunk,

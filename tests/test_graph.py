@@ -126,7 +126,7 @@ def test_measured_node_costs_integrates():
         x = b.add(ops.Dense(16), x, name=f"fc{i}")
     g = b.build()
     params = g.init(jax.random.key(1))
-    costs = measured_node_costs(g, params, reps=2, warmup=1)
+    costs = measured_node_costs(g, params, reps=2, k=8)
     assert set(costs) == set(g.topo_order)
     assert all(v > 0 for v in costs.values())
     cuts = auto_cut_points(g, 3, costs=costs)
