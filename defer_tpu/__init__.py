@@ -40,6 +40,7 @@ from .partition.partitioner import partition
 from .partition.stage import StageSpec
 from .runtime.decode import PipelinedDecoder
 from .runtime.dispatcher import Defer, DeferHandle, END_OF_STREAM
+from .runtime.speculative import speculative_generate
 from .runtime.mpmd import MpmdPipeline
 from .runtime.spmd import SpmdPipeline
 from .runtime.training import PipelineTrainer
@@ -58,6 +59,7 @@ __all__ = [
     "summary", "to_dot",
     "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",
     "SpmdPipeline", "MpmdPipeline", "PipelineTrainer", "PipelinedDecoder",
+    "speculative_generate",
     "Defer", "DeferHandle", "DeferConfig",
     "END_OF_STREAM", "PipelineMetrics", "StopwatchWindow", "models",
     "SEQ_AXIS", "ring_attention", "sequence_parallel_attention",

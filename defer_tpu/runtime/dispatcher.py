@@ -204,21 +204,18 @@ class Defer:
         return dec.generate(np.asarray(prompt_ids), max_new_tokens,
                             **sample_kw)
 
-    def score(self, graph, params, ids, *, cut_points=None,
-              num_stages: int | None = None):
-        """Per-sequence log-likelihood of token ids under a causal LM.
+    def logits(self, graph, params, ids, *, cut_points=None,
+               num_stages: int | None = None) -> np.ndarray:
+        """Full-sequence causal-LM logits [B, T, V] through the pipeline.
 
-        ``ids``: [B, T] ints (B % microbatch == 0).  Runs the causal
-        graph through the ordinary inference pipeline and sums next-token
-        log-probabilities.  Returns ``(logprob [B], perplexity [B])`` —
-        the evaluation-side companion of :meth:`generate`.
-
-        Short sequences are routed through a LENGTH-BUCKETED pipeline:
-        the graph is re-specced (same ops, same params) at the next
-        power-of-two length >= T and jitted per bucket, so scoring 16
-        tokens under a 256-token graph pays 16-position attention, not
-        256 (causal masking makes the results bit-identical).  Bucketed
-        pipelines are cached on the instance.
+        ``ids``: [B, T] ints (B % microbatch == 0).  Routed through a
+        LENGTH-BUCKETED pipeline: the graph is re-specced (same ops,
+        same params) at the next power-of-two length >= T and jitted per
+        bucket, so a 16-token batch under a 256-token graph pays
+        16-position attention, not 256 (causal masking makes the results
+        bit-identical).  Bucketed pipelines are cached on the instance.
+        The verification forward of speculative decoding and the scoring
+        path of :meth:`score` both ride this.
         """
         ids = np.asarray(ids)
         if ids.ndim != 2:
@@ -250,12 +247,29 @@ class Defer:
                 self._score_cache.pop(next(iter(self._score_cache)))
             self._score_cache[ckey] = (graph, params, pipe)
         # causal attention: right-padding cannot influence positions < t,
-        # so pad to the bucket length and score the real prefix
+        # so pad to the bucket length and read the real prefix
         padded = np.zeros((b, bucket), ids.dtype)
         padded[:, :t] = ids
-        logits = pipe.run(
+        out = pipe.run(
             padded.reshape(b // mb, mb, bucket).astype(np.float32))
-        logits = logits.reshape(b, bucket, -1)[:, :t]
+        return out.reshape(b, bucket, -1)[:, :t]
+
+    def score(self, graph, params, ids, *, cut_points=None,
+              num_stages: int | None = None):
+        """Per-sequence log-likelihood of token ids under a causal LM.
+
+        ``ids``: [B, T] ints (B % microbatch == 0).  Runs the causal
+        graph through the (length-bucketed, cached) inference pipeline
+        and sums next-token log-probabilities.  Returns
+        ``(logprob [B], perplexity [B])`` — the evaluation-side companion
+        of :meth:`generate`.
+        """
+        ids = np.asarray(ids)
+        if ids.ndim != 2:
+            raise ValueError("ids must be [B, T]")
+        b, t = ids.shape
+        logits = self.logits(graph, params, ids, cut_points=cut_points,
+                             num_stages=num_stages)
         logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
         tgt = jnp.asarray(ids[:, 1:], jnp.int32)
         pick = jnp.take_along_axis(logp[:, :-1], tgt[..., None], -1)[..., 0]

@@ -1,0 +1,100 @@
+"""Speculative decoding: token-exact greedy equivalence.
+
+The invariant that makes speculation safe: for ANY draft model, greedy
+speculative output == target-only greedy output, token for token.  The
+draft only changes how much work is spent, never what is produced.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import Defer, DeferConfig, speculative_generate
+from defer_tpu.models.gpt import gpt
+
+VOCAB = 61
+T_MODEL = 32
+
+
+@pytest.fixture(scope="module")
+def pair():
+    target = gpt(4, 32, 2, T_MODEL, vocab=VOCAB, name="spec_target")
+    tparams = target.init(jax.random.key(0))
+    draft = gpt(2, 16, 2, T_MODEL, vocab=VOCAB, name="spec_draft")
+    dparams = draft.init(jax.random.key(1))
+    return target, tparams, draft, dparams
+
+
+def reference_greedy(graph, params, prompt, max_new):
+    """Target-only greedy via full recompute per token (oracle)."""
+    out = np.array(prompt)
+    fwd = jax.jit(graph.apply)
+    for _ in range(max_new):
+        logits = np.asarray(fwd(params, out.astype(np.int32)))
+        nxt = np.argmax(logits[:, out.shape[1] - 1], axis=-1)
+        out = np.concatenate([out, nxt[:, None].astype(out.dtype)], axis=1)
+    return out
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_token_exact_vs_target_greedy(pair, gamma):
+    target, tparams, draft, dparams = pair
+    defer = Defer(config=DeferConfig(microbatch=2, chunk=4))
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, VOCAB, (4, 5)).astype(np.int64)
+    got, stats = speculative_generate(
+        defer, target, tparams, draft, dparams, prompt, 10,
+        gamma=gamma, num_stages=4, draft_num_stages=2, return_stats=True)
+    want = reference_greedy(target, tparams, prompt, 10)
+    np.testing.assert_array_equal(got, want)
+    assert stats["rounds"] >= 1 and stats["target_forwards"] >= 1
+    assert 0.0 <= stats["accept_rate"] <= 1.0
+
+
+def test_perfect_draft_accepts_everything(pair):
+    """Draft == target: every proposal accepted; each round advances
+    gamma+1 tokens, so target forwards ~ max_new / (gamma+1)."""
+    target, tparams, _, _ = pair
+    defer = Defer(config=DeferConfig(microbatch=2, chunk=4))
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, VOCAB, (2, 4)).astype(np.int64)
+    gamma, new = 4, 12
+    got, stats = speculative_generate(
+        defer, target, tparams, target, tparams, prompt, new,
+        gamma=gamma, num_stages=4, draft_num_stages=4, return_stats=True)
+    np.testing.assert_array_equal(
+        got, reference_greedy(target, tparams, prompt, new))
+    assert stats["accept_rate"] == 1.0
+    assert stats["target_forwards"] <= -(-new // (gamma + 1)) + 1
+
+
+def test_eos_freezes_sequence(pair):
+    target, tparams, draft, dparams = pair
+    defer = Defer(config=DeferConfig(microbatch=2, chunk=4))
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, VOCAB, (2, 4)).astype(np.int64)
+    # pick the actual 2nd greedy token as "eos" so it must trigger
+    ref = reference_greedy(target, tparams, prompt, 10)
+    eos = int(ref[0, 5])
+    got = speculative_generate(
+        defer, target, tparams, draft, dparams, prompt, 10,
+        gamma=3, eos_id=eos, num_stages=4, draft_num_stages=2)
+    row = got[0, 4:]
+    hits = np.where(row == eos)[0]
+    assert hits.size and (row[hits[0]:] == eos).all()
+    # pre-EOS tokens still exactly match the target-only greedy stream
+    np.testing.assert_array_equal(got[0, :4 + hits[0] + 1],
+                                  ref[0, :4 + hits[0] + 1])
+
+
+def test_validation_errors(pair):
+    target, tparams, draft, dparams = pair
+    defer = Defer(config=DeferConfig(microbatch=2, chunk=4))
+    prompt = np.zeros((2, 4), np.int64)
+    with pytest.raises(ValueError, match="gamma"):
+        speculative_generate(defer, target, tparams, draft, dparams,
+                             prompt, 4, gamma=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        speculative_generate(defer, target, tparams, draft, dparams,
+                             prompt, T_MODEL, num_stages=4)
