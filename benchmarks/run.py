@@ -72,6 +72,7 @@ PRETRAINED = {
     "resnet50_8": "resnet50",
     "vgg19_4": "vgg19",
     "mobilenetv2_2": "mobilenet_v2",
+    "bert_base_12": "bert_base",
 }
 
 

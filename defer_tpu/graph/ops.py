@@ -409,6 +409,17 @@ class TransformerBlock(Op):
     #: "auto" = Pallas flash attention on TPU / plain XLA elsewhere;
     #: "flash" and "xla" force one implementation
     attn_impl: str = "auto"
+    #: "pre" (GPT-style: x + f(LN(x))) or "post" (original-BERT style:
+    #: LN(x + f(x))) — post is required for faithful import of HF BERT
+    #: checkpoints, whose weights were trained under post-LN residuals
+    norm: str = "pre"
+    ln_eps: float = 1e-6
+
+    def __post_init__(self):
+        if self.norm not in ("pre", "post"):  # one check covers BOTH the
+            # plain and the tensor-parallel forward paths
+            raise ValueError(
+                f"norm must be 'pre' or 'post', got {self.norm!r}")
 
     def init(self, key, in_specs):
         (spec,) = in_specs
@@ -480,8 +491,10 @@ class TransformerBlock(Op):
         nh = self.num_heads
         hd = d // nh
         kvh = self._kv_head_count()
+        eps = self.ln_eps
+        post = self.norm == "post"  # validated in __post_init__
 
-        y = self._ln(p["ln1"], x)
+        y = x if post else self._ln(p["ln1"], x, eps)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
         q, k, v = self._split_qkv(qkv)
         qh = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
@@ -493,11 +506,17 @@ class TransformerBlock(Op):
             vh = jnp.repeat(vh, nh // kvh, axis=1)
         y = self._attend(qh, kh, vh)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, d)
-        x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
+        y = y @ p["proj"]["w"] + p["proj"]["b"]
+        x = self._ln(p["ln1"], x + y, eps) if post else x + y
 
-        y = self._ln(p["ln2"], x)
-        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
-        return x + (y @ p["fc2"]["w"] + p["fc2"]["b"]), k, v
+        y = x if post else self._ln(p["ln2"], x, eps)
+        # post-LN (BERT) uses the exact erf GELU like HF; pre-LN keeps
+        # the tanh approximation (GPT-2 convention, existing behavior)
+        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"],
+                        approximate=not post)
+        y = y @ p["fc2"]["w"] + p["fc2"]["b"]
+        out = self._ln(p["ln2"], x + y, eps) if post else x + y
+        return out, k, v
 
     def flops(self, in_specs, out_spec):
         (spec,) = in_specs
@@ -543,8 +562,10 @@ class TransformerBlock(Op):
         nh = self.num_heads // tp           # local heads
         dl = p["qkv"]["w"].shape[1] // 3    # local head-group width d/tp
         hd = dl // nh
+        eps = self.ln_eps
+        post = self.norm == "post"          # mirror apply_with_kv exactly
 
-        y = self._ln(p["ln1"], x)
+        y = x if post else self._ln(p["ln1"], x, eps)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
@@ -552,11 +573,14 @@ class TransformerBlock(Op):
         v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
         y = self._attend(q, k, v)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, dl)
-        x = x + lax.psum(y @ p["proj"]["w"], axis_name) + p["proj"]["b"]
+        y = lax.psum(y @ p["proj"]["w"], axis_name) + p["proj"]["b"]
+        x = self._ln(p["ln1"], x + y, eps) if post else x + y
 
-        y = self._ln(p["ln2"], x)
-        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
-        return x + lax.psum(y @ p["fc2"]["w"], axis_name) + p["fc2"]["b"]
+        y = x if post else self._ln(p["ln2"], x, eps)
+        y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"],
+                        approximate=not post)
+        y = lax.psum(y @ p["fc2"]["w"], axis_name) + p["fc2"]["b"]
+        return self._ln(p["ln2"], x + y, eps) if post else x + y
 
 
 # ---------------------------------------------------------------------------

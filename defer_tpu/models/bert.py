@@ -15,16 +15,23 @@ import jax
 import jax.numpy as jnp
 
 from ..graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
-from ..graph.ops import Dense, LayerNorm, TransformerBlock
+from ..graph.ops import TransformerBlock
 
 
 class BertEmbedding(Op):
-    """Token + learned positional embeddings, followed by layer norm."""
+    """Token + learned positional embeddings, followed by layer norm.
 
-    def __init__(self, vocab: int, features: int, max_len: int):
+    HF's segment (token-type) embedding is not a separate table here: for
+    single-segment inputs it is a constant vector added pre-LN, so the
+    importer folds ``token_type_embeddings[0]`` into ``pos`` exactly.
+    """
+
+    def __init__(self, vocab: int, features: int, max_len: int,
+                 eps: float = 1e-12):
         self.vocab = vocab
         self.features = features
         self.max_len = max_len
+        self.eps = eps
 
     def init(self, key, in_specs):
         (spec,) = in_specs
@@ -44,7 +51,8 @@ class BertEmbedding(Op):
         mu = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
         ln = params["ln"]
-        return (x - mu) * jax.lax.rsqrt(var + 1e-6) * ln["scale"] + ln["bias"]
+        return (x - mu) * jax.lax.rsqrt(var + self.eps) \
+            * ln["scale"] + ln["bias"]
 
     def flops(self, in_specs, out_spec):
         return out_spec.size
@@ -75,12 +83,16 @@ class Pooler(Op):
 
 def bert(num_layers: int, hidden: int, heads: int, seq_len: int,
          vocab: int = 30522, name: str = "bert") -> LayerGraph:
+    """Faithful original-BERT encoder: post-LN residual blocks with exact
+    GELU and eps=1e-12 (matching HF ``bert-base-uncased``), no trailing
+    LayerNorm (post-LN blocks end normalized) — so HF checkpoints import
+    with matching semantics, not just matching shapes."""
     b = GraphBuilder(name)
     x = b.input((seq_len,), jnp.int32)
     x = b.add(BertEmbedding(vocab, hidden, seq_len), x, name="embeddings")
     for i in range(num_layers):
-        x = b.add(TransformerBlock(heads), x, name=f"block_{i}")
-    x = b.add(LayerNorm(), x, name="final_ln")
+        x = b.add(TransformerBlock(heads, norm="post", ln_eps=1e-12),
+                  x, name=f"block_{i}")
     x = b.add(Pooler(hidden), x, name="pooler")
     return b.build()
 
@@ -94,5 +106,5 @@ def bert_tiny(seq_len: int = 16) -> LayerGraph:
 
 
 #: one encoder block per stage (BASELINE.md config 5): 12 stages — stage 0
-#: holds embeddings + block_0, stage 11 holds block_11 + final_ln + pooler
+#: holds embeddings + block_0, stage 11 holds block_11 + pooler
 BERT_BASE_12STAGE_CUTS = [f"block_{i}" for i in range(11)]

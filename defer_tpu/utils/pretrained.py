@@ -199,6 +199,90 @@ def mobilenet_v2_torch_mapping() -> dict[tuple[str, str],
     return m
 
 
+def _fuse_qkv(q: np.ndarray, k: np.ndarray, v: np.ndarray) -> np.ndarray:
+    """HF's separate q/k/v ``[out, in]`` matrices -> one fused ``[in, 3d]``."""
+    return np.concatenate([q.T, k.T, v.T], axis=1)
+
+
+def _fuse_qkv_bias(q: np.ndarray, k: np.ndarray, v: np.ndarray) -> np.ndarray:
+    return np.concatenate([q, k, v])
+
+
+def _fold_pos_tt(max_len: int) -> Callable:
+    """position_embeddings[:max_len] + token_type_embeddings[0]:
+    single-segment inputs add the segment-0 vector at every position
+    pre-LN, so it folds into the positional table exactly; the real
+    checkpoint's 512-row table is cropped to the deployed sequence
+    length (HF slices position_ids the same way)."""
+    def t(pos: np.ndarray, tt: np.ndarray) -> np.ndarray:
+        return pos[:max_len] + tt[0]
+    t.__name__ = "_fold_pos_tt"
+    return t
+
+
+def bert_torch_mapping(num_layers: int, max_len: int = 512
+                       ) -> dict[tuple[str, str], tuple[Any, Callable]]:
+    """(our_node, our_leaf_path) -> (HF state_dict key(s), transform) for
+    ``models.bert.bert`` (post-LN blocks, fused qkv).
+
+    HF prefix conventions: plain ``bert-base-uncased`` state_dicts carry
+    ``bert.``-prefixed keys when saved from a task model; strip that
+    before calling (see ``load_pretrained_bert_base``).
+    """
+    m: dict[tuple[str, str], tuple[Any, Callable]] = {}
+    e = "embeddings"
+    m[(e, "tok")] = (f"{e}.word_embeddings.weight", _ident)
+    m[(e, "pos")] = ((f"{e}.position_embeddings.weight",
+                      f"{e}.token_type_embeddings.weight"),
+                     _fold_pos_tt(max_len))
+    m[(e, "ln/scale")] = (f"{e}.LayerNorm.weight", _ident)
+    m[(e, "ln/bias")] = (f"{e}.LayerNorm.bias", _ident)
+    for i in range(num_layers):
+        b = f"encoder.layer.{i}"
+        node = f"block_{i}"
+        a = f"{b}.attention"
+        m[(node, "qkv/w")] = ((f"{a}.self.query.weight",
+                               f"{a}.self.key.weight",
+                               f"{a}.self.value.weight"), _fuse_qkv)
+        m[(node, "qkv/b")] = ((f"{a}.self.query.bias",
+                               f"{a}.self.key.bias",
+                               f"{a}.self.value.bias"), _fuse_qkv_bias)
+        m[(node, "proj/w")] = (f"{a}.output.dense.weight", _fc_t)
+        m[(node, "proj/b")] = (f"{a}.output.dense.bias", _ident)
+        m[(node, "ln1/scale")] = (f"{a}.output.LayerNorm.weight", _ident)
+        m[(node, "ln1/bias")] = (f"{a}.output.LayerNorm.bias", _ident)
+        m[(node, "fc1/w")] = (f"{b}.intermediate.dense.weight", _fc_t)
+        m[(node, "fc1/b")] = (f"{b}.intermediate.dense.bias", _ident)
+        m[(node, "fc2/w")] = (f"{b}.output.dense.weight", _fc_t)
+        m[(node, "fc2/b")] = (f"{b}.output.dense.bias", _ident)
+        m[(node, "ln2/scale")] = (f"{b}.output.LayerNorm.weight", _ident)
+        m[(node, "ln2/bias")] = (f"{b}.output.LayerNorm.bias", _ident)
+    m[("pooler", "w")] = ("pooler.dense.weight", _fc_t)
+    m[("pooler", "b")] = ("pooler.dense.bias", _ident)
+    return m
+
+
+def load_pretrained_bert_base(path: str, graph: LayerGraph | None = None
+                              ) -> dict[str, Any]:
+    """Load an HF-layout BERT checkpoint (or our flat layout) as params."""
+    if graph is None:
+        from ..models import bert_base
+        graph = bert_base()
+    expected = _expected_shapes(graph)
+    sd = _read_state_dict(path)
+    # task-model saves prefix everything with "bert." — strip it
+    if any(k.startswith("bert.") for k in sd):
+        sd = {k[len("bert."):]: v for k, v in sd.items()
+              if k.startswith("bert.")}
+    if any(k.startswith("encoder.layer.") for k in sd):  # HF layout
+        n_layers = sum(1 for n in graph.nodes if n.startswith("block_"))
+        max_len = graph.input_spec.shape[0]
+        return convert_state_dict(bert_torch_mapping(n_layers, max_len),
+                                  sd, expected, "BERT")
+    from .checkpoint import load_params
+    return load_params(path, expected)
+
+
 def _read_state_dict(path: str) -> dict[str, np.ndarray]:
     ext = os.path.splitext(path)[1].lower()
     if ext == ".npz":
@@ -223,12 +307,16 @@ def _read_state_dict(path: str) -> dict[str, np.ndarray]:
                      f"(want .npz, .pt/.pth/.bin, or .safetensors)")
 
 
-def convert_state_dict(mapping: dict[tuple[str, str], tuple[str, Callable]],
-                       sd: dict[str, np.ndarray],
-                       expected: dict[str, Any],
-                       what: str) -> dict[str, Any]:
-    """Apply a (our_node, our_leaf) -> (source_key, transform) mapping,
-    shape-checked leaf by leaf.
+def convert_state_dict(
+    mapping: dict[tuple[str, str], tuple["str | tuple[str, ...]", Callable]],
+    sd: dict[str, np.ndarray],
+    expected: dict[str, Any],
+    what: str,
+) -> dict[str, Any]:
+    """Apply a (our_node, our_leaf_path) -> (source_key(s), transform)
+    mapping, shape-checked leaf by leaf.  ``source_key(s)`` may be a
+    tuple — the transform then fuses several source arrays into one leaf
+    (HF BERT's q/k/v -> fused qkv, segment fold).
 
     ``expected`` is the pytree from ``graph.init`` — its shapes are the
     contract; any missing source key or post-transform shape mismatch
@@ -237,16 +325,28 @@ def convert_state_dict(mapping: dict[tuple[str, str], tuple[str, Callable]],
     out: dict[str, Any] = {}
     missing, mismatched = [], []
     for (node, leaf), (src, tf) in mapping.items():
-        want = np.shape(expected[node][leaf])
-        if src not in sd:
-            missing.append(src)
+        # leaf may be a "/"-joined path into a nested node pytree, and
+        # src may be a tuple of source keys fused by the transform
+        # (e.g. HF BERT's separate q/k/v -> one fused qkv matrix)
+        srcs = src if isinstance(src, tuple) else (src,)
+        absent = [k for k in srcs if k not in sd]
+        if absent:
+            missing.extend(absent)
             continue
-        arr = tf(np.asarray(sd[src]))
+        path = leaf.split("/")
+        want_leaf = expected[node]
+        for part in path:
+            want_leaf = want_leaf[part]
+        want = np.shape(want_leaf)
+        arr = tf(*(np.asarray(sd[k]) for k in srcs))
         if arr.shape != want:
             mismatched.append(f"{src} -> {node}/{leaf}: got {arr.shape}, "
                               f"want {want}")
             continue
-        out.setdefault(node, {})[leaf] = arr.astype(np.float32)
+        dst = out.setdefault(node, {})
+        for part in path[:-1]:
+            dst = dst.setdefault(part, {})
+        dst[path[-1]] = arr.astype(np.float32)
     if missing or mismatched:
         raise ValueError(
             f"checkpoint does not match {what}: "
@@ -335,6 +435,7 @@ PRETRAINED_LOADERS: dict[str, Callable] = {
     "resnet50": load_pretrained_resnet50,
     "vgg19": load_pretrained_vgg19,
     "mobilenet_v2": load_pretrained_mobilenet_v2,
+    "bert_base": load_pretrained_bert_base,
 }
 
 

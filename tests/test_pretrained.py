@@ -291,6 +291,98 @@ def test_mobilenet_v2_convert_and_logit_match():
     np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-3)
 
 
+def test_bert_convert_and_logit_match():
+    """HF-layout BERT conversion (fused qkv, folded segment embedding,
+    post-LN residuals, exact GELU, eps 1e-12): converted params must
+    reproduce a torch functional reference of HF's forward."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    from defer_tpu.models.bert import bert
+    from defer_tpu.utils.pretrained import (bert_torch_mapping,
+                                            convert_state_dict)
+
+    L, D, H, T, V = 2, 32, 2, 16, 50
+    g = bert(L, D, H, T, vocab=V, name="bert_fixture")
+    expected = jax.eval_shape(lambda: g.init(jax.random.key(0)))
+    mapping = bert_torch_mapping(L, max_len=T)
+
+    rng = np.random.default_rng(17)
+    sd = {}
+    e = "embeddings"
+    sd[f"{e}.word_embeddings.weight"] = \
+        (rng.standard_normal((V, D)) * 0.1).astype(np.float32)
+    # the REAL checkpoint carries a longer (512-row) positional table
+    # than the deployed seq_len — the importer must crop it
+    sd[f"{e}.position_embeddings.weight"] = \
+        (rng.standard_normal((4 * T, D)) * 0.1).astype(np.float32)
+    sd[f"{e}.token_type_embeddings.weight"] = \
+        (rng.standard_normal((2, D)) * 0.1).astype(np.float32)
+    sd[f"{e}.LayerNorm.weight"] = np.ones(D, np.float32)
+    sd[f"{e}.LayerNorm.bias"] = np.zeros(D, np.float32)
+    for i in range(L):
+        b = f"encoder.layer.{i}"
+        for part, shape in (
+                (f"{b}.attention.self.query", (D, D)),
+                (f"{b}.attention.self.key", (D, D)),
+                (f"{b}.attention.self.value", (D, D)),
+                (f"{b}.attention.output.dense", (D, D)),
+                (f"{b}.intermediate.dense", (4 * D, D)),
+                (f"{b}.output.dense", (D, 4 * D))):
+            sd[f"{part}.weight"] = \
+                (rng.standard_normal(shape) * 0.1).astype(np.float32)
+            sd[f"{part}.bias"] = \
+                (rng.standard_normal(shape[0]) * 0.02).astype(np.float32)
+        for ln in (f"{b}.attention.output.LayerNorm", f"{b}.output.LayerNorm"):
+            sd[f"{ln}.weight"] = \
+                (1 + rng.standard_normal(D) * 0.02).astype(np.float32)
+            sd[f"{ln}.bias"] = \
+                (rng.standard_normal(D) * 0.02).astype(np.float32)
+    sd["pooler.dense.weight"] = \
+        (rng.standard_normal((D, D)) * 0.1).astype(np.float32)
+    sd["pooler.dense.bias"] = \
+        (rng.standard_normal(D) * 0.02).astype(np.float32)
+
+    params = convert_state_dict(mapping, sd, expected, "BERT-fixture")
+
+    def tt(k):
+        return torch.from_numpy(sd[k]).double()
+
+    def layer_norm(x, w, b):
+        return F.layer_norm(x, (D,), w, b, eps=1e-12)
+
+    ids = rng.integers(0, V, (2, T))
+    x = (tt(f"{e}.word_embeddings.weight")[torch.from_numpy(ids)]
+         + tt(f"{e}.position_embeddings.weight")[:T][None]
+         + tt(f"{e}.token_type_embeddings.weight")[0][None, None])
+    x = layer_norm(x, tt(f"{e}.LayerNorm.weight"), tt(f"{e}.LayerNorm.bias"))
+    hd = D // H
+    for i in range(L):
+        b = f"encoder.layer.{i}"
+        a = f"{b}.attention"
+
+        def proj(t_in, part):
+            return F.linear(t_in, tt(f"{part}.weight"), tt(f"{part}.bias"))
+
+        q = proj(x, f"{a}.self.query").view(2, T, H, hd).transpose(1, 2)
+        k = proj(x, f"{a}.self.key").view(2, T, H, hd).transpose(1, 2)
+        v = proj(x, f"{a}.self.value").view(2, T, H, hd).transpose(1, 2)
+        att = torch.softmax(q @ k.transpose(-1, -2) / np.sqrt(hd), dim=-1)
+        y = (att @ v).transpose(1, 2).reshape(2, T, D)
+        y = proj(y, f"{a}.output.dense")
+        x = layer_norm(x + y, tt(f"{a}.output.LayerNorm.weight"),
+                       tt(f"{a}.output.LayerNorm.bias"))
+        y = F.gelu(proj(x, f"{b}.intermediate.dense"))  # exact erf gelu
+        y = proj(y, f"{b}.output.dense")
+        x = layer_norm(x + y, tt(f"{b}.output.LayerNorm.weight"),
+                       tt(f"{b}.output.LayerNorm.bias"))
+    ref = torch.tanh(F.linear(x[:, 0], tt("pooler.dense.weight"),
+                              tt("pooler.dense.bias"))).numpy()
+
+    ours = np.asarray(jax.jit(g.apply)(params, ids.astype(np.int32)),
+                      np.float64)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
 def test_torch_pt_container(tmp_path, small):
     torch = pytest.importorskip("torch")
     g, expected = small
