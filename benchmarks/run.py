@@ -71,6 +71,7 @@ def sample(shape, kind, microbatch, lead=()):
 PRETRAINED = {
     "resnet50_8": "resnet50",
     "vgg19_4": "vgg19",
+    "inceptionv3_6": "inception_v3",
     "mobilenetv2_2": "mobilenet_v2",
     "bert_base_12": "bert_base",
 }

@@ -313,6 +313,11 @@ class AvgPool(Op):
     window: int = 2
     stride: int | None = None
     padding: str = "VALID"
+    #: True = divide by window**2 even where the window overlaps padding
+    #: (torch ``avg_pool2d``'s default, used by torchvision InceptionV3's
+    #: pool branches); False = divide by the valid-element count (XLA/
+    #: Keras semantics).
+    count_include_pad: bool = False
 
     def apply(self, params, x):
         del params
@@ -324,6 +329,8 @@ class AvgPool(Op):
         summed = lax.reduce_window(x, 0.0, lax.add,
                                    (1, self.window, self.window, 1),
                                    (1, s, s, 1), self.padding)
+        if self.count_include_pad:
+            return summed / jnp.asarray(self.window * self.window, x.dtype)
         # window counts depend only on static shape/padding: bake them in
         # as a numpy constant
         counts = _window_counts(x.shape[1:3], self.window, s, self.padding)

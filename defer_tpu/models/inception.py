@@ -23,9 +23,9 @@ from ..graph.ops import (Activation, AvgPool, BatchNorm, Concat, Conv2D,
 
 
 def _cbr(b: GraphBuilder, x: str, feats: int, kernel, stride=1,
-         padding="SAME") -> str:
+         padding="SAME", eps=1e-5) -> str:
     x = b.add(Conv2D(feats, kernel, stride, padding, use_bias=False), x)
-    x = b.add(BatchNorm(), x)
+    x = b.add(BatchNorm(eps=eps), x)
     return b.add(Activation("relu"), x)
 
 
@@ -97,8 +97,111 @@ def inception(width: int = 64, num_classes: int = 1000,
     return b.build()
 
 
+def _tcbr(b: GraphBuilder, x: str, feats: int, kernel, stride=1,
+          padding="SAME") -> str:
+    """torchvision ``BasicConv2d``: conv (no bias) + BN(eps=1e-3) + relu.
+
+    All stride-2 convs in InceptionV3 are pad-0 (= VALID, identical in
+    torch and XLA); all stride-1 convs pad symmetrically to k//2 per side
+    (= SAME at stride 1), so no explicit padding tuples are needed —
+    unlike the torch-trained ResNet/MobileNet imports.
+    """
+    return _cbr(b, x, feats, kernel, stride, padding, eps=1e-3)
+
+
+def _tpool_branch(b: GraphBuilder, x: str, feats: int) -> str:
+    # torch F.avg_pool2d(x, 3, stride=1, padding=1): count_include_pad
+    pool = b.add(AvgPool(3, 1, "SAME", count_include_pad=True), x)
+    return _tcbr(b, pool, feats, 1)
+
+
+def _t_block_a(b: GraphBuilder, x: str, pool_feats: int, idx: int) -> str:
+    b1 = _tcbr(b, x, 64, 1)
+    b5 = _tcbr(b, _tcbr(b, x, 48, 1), 64, 5)
+    bd = _tcbr(b, _tcbr(b, _tcbr(b, x, 64, 1), 96, 3), 96, 3)
+    bp = _tpool_branch(b, x, pool_feats)
+    return b.add(Concat(), [b1, b5, bd, bp], name=f"mixed_{idx}")
+
+
+def _t_block_b(b: GraphBuilder, x: str, idx: int) -> str:
+    b3 = _tcbr(b, x, 384, 3, stride=2, padding="VALID")
+    bd = _tcbr(b, _tcbr(b, _tcbr(b, x, 64, 1), 96, 3), 96, 3, stride=2,
+               padding="VALID")
+    bp = b.add(MaxPool(3, 2, "VALID"), x)
+    return b.add(Concat(), [b3, bd, bp], name=f"mixed_{idx}")
+
+
+def _t_block_c(b: GraphBuilder, x: str, c7: int, idx: int) -> str:
+    b1 = _tcbr(b, x, 192, 1)
+    b7 = _tcbr(b, _tcbr(b, _tcbr(b, x, c7, 1), c7, (1, 7)), 192, (7, 1))
+    bd = _tcbr(b, _tcbr(b, _tcbr(b, _tcbr(b, _tcbr(
+        b, x, c7, 1), c7, (7, 1)), c7, (1, 7)), c7, (7, 1)), 192, (1, 7))
+    bp = _tpool_branch(b, x, 192)
+    return b.add(Concat(), [b1, b7, bd, bp], name=f"mixed_{idx}")
+
+
+def _t_block_d(b: GraphBuilder, x: str, idx: int) -> str:
+    b3 = _tcbr(b, _tcbr(b, x, 192, 1), 320, 3, stride=2, padding="VALID")
+    b7 = _tcbr(b, _tcbr(b, _tcbr(b, _tcbr(
+        b, x, 192, 1), 192, (1, 7)), 192, (7, 1)), 192, 3, stride=2,
+        padding="VALID")
+    bp = b.add(MaxPool(3, 2, "VALID"), x)
+    return b.add(Concat(), [b3, b7, bp], name=f"mixed_{idx}")
+
+
+def _t_block_e(b: GraphBuilder, x: str, idx: int) -> str:
+    b1 = _tcbr(b, x, 320, 1)
+    m3 = _tcbr(b, x, 384, 1)
+    b3 = b.add(Concat(),
+               [_tcbr(b, m3, 384, (1, 3)), _tcbr(b, m3, 384, (3, 1))])
+    md = _tcbr(b, _tcbr(b, x, 448, 1), 384, 3)
+    bd = b.add(Concat(),
+               [_tcbr(b, md, 384, (1, 3)), _tcbr(b, md, 384, (3, 1))])
+    bp = _tpool_branch(b, x, 192)
+    return b.add(Concat(), [b1, b3, bd, bp], name=f"mixed_{idx}")
+
+
 def inception_v3(num_classes: int = 1000, image_size: int = 299) -> LayerGraph:
-    return inception(64, num_classes, image_size, name="inception_v3")
+    """Exact torchvision InceptionV3 (eval semantics, no aux head).
+
+    Block-for-block and channel-for-channel the torchvision module tree —
+    Conv2d_1a..4a stem, Mixed_5b/5c/5d (A, pool 32/64/64), Mixed_6a (B),
+    Mixed_6b..6e (C, c7 128/160/160/192), Mixed_7a (D), Mixed_7b/7c (E) —
+    named ``mixed_0..mixed_10`` here, so torchvision checkpoints import
+    weight-for-weight (``utils/pretrained.py: inception_v3_torch_mapping``)
+    and the benchmark config measures the real InceptionV3 FLOPs.  BN eps
+    is 1e-3 and the pool branches divide by 9 at the borders
+    (``count_include_pad``), both matching torch.  The aux classifier and
+    train-time dropout do not exist in the inference graph; torchvision's
+    ``transform_input`` re-normalization is a preprocessing concern (feed
+    TF-style ``(x-0.5)/0.5`` inputs, or apply the affine before ingest).
+    """
+    b = GraphBuilder("inception_v3")
+    x = b.input((image_size, image_size, 3), jnp.float32)
+    x = _tcbr(b, x, 32, 3, stride=2, padding="VALID")   # Conv2d_1a_3x3
+    x = _tcbr(b, x, 32, 3, padding="VALID")             # Conv2d_2a_3x3
+    x = _tcbr(b, x, 64, 3)                              # Conv2d_2b_3x3
+    x = b.add(MaxPool(3, 2, "VALID"), x, name="stem_pool")
+    x = _tcbr(b, x, 80, 1, padding="VALID")             # Conv2d_3b_1x1
+    x = _tcbr(b, x, 192, 3, padding="VALID")            # Conv2d_4a_3x3
+    x = b.add(MaxPool(3, 2, "VALID"), x, name="stem_pool2")
+    idx = 0
+    for pool_feats in (32, 64, 64):                     # Mixed_5b/5c/5d
+        x = _t_block_a(b, x, pool_feats, idx)
+        idx += 1
+    x = _t_block_b(b, x, idx)                           # Mixed_6a
+    idx += 1
+    for c7 in (128, 160, 160, 192):                     # Mixed_6b..6e
+        x = _t_block_c(b, x, c7, idx)
+        idx += 1
+    x = _t_block_d(b, x, idx)                           # Mixed_7a
+    idx += 1
+    for _ in range(2):                                  # Mixed_7b/7c
+        x = _t_block_e(b, x, idx)
+        idx += 1
+    x = b.add(GlobalAvgPool(), x, name="avg_pool")
+    x = b.add(Dense(num_classes), x, name="predictions")
+    return b.build()
 
 
 def inception_tiny(num_classes: int = 10, image_size: int = 75) -> LayerGraph:

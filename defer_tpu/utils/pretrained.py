@@ -199,6 +199,63 @@ def mobilenet_v2_torch_mapping() -> dict[tuple[str, str],
     return m
 
 
+#: torchvision InceptionV3 ``BasicConv2d`` module prefixes, in the exact
+#: order ``models.inception.inception_v3`` adds its conv/bn pairs.  The
+#: builder constructs branches in torch constructor order (branch1x1,
+#: branch5x5/3x3/7x7 chains, branch_pool), so this is a straight walk of
+#: the torchvision module tree.
+_INCEPTION_A = ("branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1",
+                "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool")
+_INCEPTION_B = ("branch3x3", "branch3x3dbl_1", "branch3x3dbl_2",
+                "branch3x3dbl_3")
+_INCEPTION_C = ("branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3",
+                "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3",
+                "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool")
+_INCEPTION_D = ("branch3x3_1", "branch3x3_2", "branch7x7x3_1",
+                "branch7x7x3_2", "branch7x7x3_3", "branch7x7x3_4")
+_INCEPTION_E = ("branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b",
+                "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a",
+                "branch3x3dbl_3b", "branch_pool")
+
+
+def inception_v3_conv_order() -> list[str]:
+    """torchvision module prefixes of every BasicConv2d, forward order."""
+    order = ["Conv2d_1a_3x3", "Conv2d_2a_3x3", "Conv2d_2b_3x3",
+             "Conv2d_3b_1x1", "Conv2d_4a_3x3"]
+    blocks = (
+        [("Mixed_5b", _INCEPTION_A), ("Mixed_5c", _INCEPTION_A),
+         ("Mixed_5d", _INCEPTION_A), ("Mixed_6a", _INCEPTION_B)]
+        + [(f"Mixed_6{s}", _INCEPTION_C) for s in "bcde"]
+        + [("Mixed_7a", _INCEPTION_D), ("Mixed_7b", _INCEPTION_E),
+           ("Mixed_7c", _INCEPTION_E)])
+    for block, branches in blocks:
+        order.extend(f"{block}.{br}" for br in branches)
+    return order
+
+
+def inception_v3_torch_mapping() -> dict[tuple[str, str],
+                                         tuple[str, Callable]]:
+    """(our_node, our_leaf) -> (torchvision key, transform) for
+    ``models.inception.inception_v3``.
+
+    Same builder-order-counter scheme as the MobileNetV2 mapping: the
+    k-th conv2d/batchnorm pair the builder creates corresponds to the
+    k-th ``BasicConv2d`` in torchvision forward order
+    (``inception_v3_conv_order``).  ``AuxLogits.*`` keys are ignored —
+    the aux head does not exist in eval-mode inference.
+    """
+    m: dict[tuple[str, str], tuple[str, Callable]] = {}
+    for i, prefix in enumerate(inception_v3_conv_order()):
+        conv = "conv2d" if i == 0 else f"conv2d_{i}"
+        bn = "batchnorm" if i == 0 else f"batchnorm_{i}"
+        m[(conv, "w")] = (f"{prefix}.conv.weight", _conv_t)
+        for theirs, ours in _BN_LEAVES.items():
+            m[(bn, ours)] = (f"{prefix}.bn.{theirs}", _ident)
+    m[("predictions", "w")] = ("fc.weight", _fc_t)
+    m[("predictions", "b")] = ("fc.bias", _ident)
+    return m
+
+
 def _fuse_qkv(q: np.ndarray, k: np.ndarray, v: np.ndarray) -> np.ndarray:
     """HF's separate q/k/v ``[out, in]`` matrices -> one fused ``[in, 3d]``."""
     return np.concatenate([q.T, k.T, v.T], axis=1)
@@ -430,12 +487,35 @@ def load_pretrained_mobilenet_v2(path: str, graph: LayerGraph | None = None
     return load_params(path, expected)
 
 
+def load_pretrained_inception_v3(path: str, graph: LayerGraph | None = None
+                                 ) -> dict[str, Any]:
+    """Load an InceptionV3 checkpoint (torchvision or our flat layout).
+
+    Reference parity: the reference benchmarks trained Keras models
+    (reference test/test.py:13-14); InceptionV3 is BASELINE config 3.
+    Inputs must be TF-style normalized (``(x-0.5)/0.5``) — torchvision's
+    ``transform_input=True`` re-normalization is preprocessing, not part
+    of the graph.
+    """
+    if graph is None:
+        from ..models import inception_v3
+        graph = inception_v3()
+    expected = _expected_shapes(graph)
+    sd = _read_state_dict(path)
+    if any(k.startswith(("Conv2d_1a", "Mixed_")) for k in sd):
+        return convert_state_dict(inception_v3_torch_mapping(), sd,
+                                  expected, "InceptionV3")
+    from .checkpoint import load_params
+    return load_params(path, expected)
+
+
 #: model-family name -> loader, for generic call sites (bench/CLI)
 PRETRAINED_LOADERS: dict[str, Callable] = {
     "resnet50": load_pretrained_resnet50,
     "vgg19": load_pretrained_vgg19,
     "mobilenet_v2": load_pretrained_mobilenet_v2,
     "bert_base": load_pretrained_bert_base,
+    "inception_v3": load_pretrained_inception_v3,
 }
 
 

@@ -26,6 +26,28 @@ def small():
     return g, g.init(jax.random.key(0))
 
 
+def _random_sd_from_mapping(mapping, expected, rng):
+    """Random torchvision-layout state dict whose inverse-transformed
+    shapes match ``expected`` — the shared fixture generator for every
+    family's logit-match test (torch-native shapes per transform kind)."""
+    sd = {}
+    for (_node, _leaf), (src, tf) in mapping.items():
+        if src in sd:
+            continue
+        want = np.shape(expected[_node][_leaf])
+        if tf.__name__ == "_conv_t":
+            shp = (want[3], want[2], want[0], want[1])
+        elif tf.__name__ in ("_fc_t", "_fc1_t"):
+            shp = (want[1], want[0])
+        else:
+            shp = want
+        val = rng.standard_normal(shp) * 0.1
+        if src.endswith("running_var"):
+            val = np.abs(val) + 0.5
+        sd[src] = val.astype(np.float32)
+    return sd
+
+
 def _synthetic_torch_sd(expected, depths):
     """Random state_dict in torchvision layout whose inverse-transformed
     values equal a reference pytree (so conversion is exactly checkable)."""
@@ -92,21 +114,7 @@ def test_resnet_convert_and_logit_match():
     mapping = resnet50_torch_mapping(depths)
 
     rng = np.random.default_rng(8)
-    sd = {}
-    for (_node, _leaf), (src, tf) in mapping.items():
-        if src in sd:
-            continue
-        want = np.shape(expected[_node][_leaf])
-        if tf.__name__ == "_conv_t":
-            shp = (want[3], want[2], want[0], want[1])
-        elif tf.__name__ == "_fc_t":
-            shp = (want[1], want[0])
-        else:
-            shp = want
-        val = rng.standard_normal(shp) * 0.1
-        if src.endswith("running_var"):
-            val = np.abs(val) + 0.5
-        sd[src] = val.astype(np.float32)
+    sd = _random_sd_from_mapping(mapping, expected, rng)
 
     params = convert_resnet50_state_dict(sd, expected, depths)
 
@@ -190,22 +198,8 @@ def test_vgg_convert_and_logit_match():
     expected = jax.eval_shape(lambda: g.init(jax.random.key(0)))
 
     rng = np.random.default_rng(3)
-    sd = {}
     mapping = vgg_torch_mapping(cfg, (8, 8, 16))
-    for (_node, _leaf), (src, tf) in mapping.items():
-        if src in sd:
-            continue
-        # build the torch-side tensor with torch-native shapes
-        want = np.shape(expected[_node][_leaf])
-        if tf.__name__ == "_conv_t":
-            shp = (want[3], want[2], want[0], want[1])
-        elif tf.__name__ == "_fc1_t":
-            shp = (want[1], want[0])
-        elif tf.__name__ == "_fc_t":
-            shp = (want[1], want[0])
-        else:
-            shp = want
-        sd[src] = (rng.standard_normal(shp) * 0.1).astype(np.float32)
+    sd = _random_sd_from_mapping(mapping, expected, rng)
 
     params = convert_state_dict(mapping, sd, expected, "VGG-fixture")
     x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
@@ -231,21 +225,7 @@ def test_mobilenet_v2_convert_and_logit_match():
     mapping = mobilenet_v2_torch_mapping()
 
     rng = np.random.default_rng(5)
-    sd = {}
-    for (_node, _leaf), (src, tf) in mapping.items():
-        if src in sd:
-            continue
-        want = np.shape(expected[_node][_leaf])
-        if tf.__name__ == "_conv_t":
-            shp = (want[3], want[2], want[0], want[1])
-        elif tf.__name__ == "_fc_t":
-            shp = (want[1], want[0])
-        else:
-            shp = want
-        val = rng.standard_normal(shp) * 0.1
-        if src.endswith("running_var"):
-            val = np.abs(val) + 0.5  # a real variance
-        sd[src] = val.astype(np.float32)
+    sd = _random_sd_from_mapping(mapping, expected, rng)
 
     params = convert_state_dict(mapping, sd, expected, "MNV2-fixture")
 
@@ -419,3 +399,135 @@ def test_full_resnet50_mapping_covers_every_leaf():
     assert addressed == parametric
     # standard torchvision key census: 53 convs + 53 bns * 4 + fc * 2
     assert len({src for src, _ in mapping.values()}) == 53 + 53 * 4 + 2
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3
+
+
+def _torch_inception_logits(sd, x_nhwc):
+    """Independent NCHW reference forward of a torchvision-layout
+    InceptionV3 state_dict (eval semantics: no aux head, no dropout) —
+    op-by-op from the torchvision module tree, in float64."""
+    import torch
+    import torch.nn.functional as F
+
+    def tt(key):
+        return torch.from_numpy(sd[key]).double()
+
+    def bconv(t, prefix, stride=1, same=True):
+        w = tt(f"{prefix}.conv.weight")
+        pad = (w.shape[-2] // 2, w.shape[-1] // 2) if same else 0
+        t = F.conv2d(t, w, None, stride=stride, padding=pad)
+        t = F.batch_norm(t, tt(f"{prefix}.bn.running_mean"),
+                         tt(f"{prefix}.bn.running_var"),
+                         tt(f"{prefix}.bn.weight"), tt(f"{prefix}.bn.bias"),
+                         training=False, eps=1e-3)
+        return F.relu(t)
+
+    def block_a(t, p):
+        b1 = bconv(t, f"{p}.branch1x1")
+        b5 = bconv(bconv(t, f"{p}.branch5x5_1"), f"{p}.branch5x5_2")
+        bd = bconv(bconv(bconv(t, f"{p}.branch3x3dbl_1"),
+                         f"{p}.branch3x3dbl_2"), f"{p}.branch3x3dbl_3")
+        bp = bconv(F.avg_pool2d(t, 3, 1, 1), f"{p}.branch_pool")
+        return torch.cat([b1, b5, bd, bp], 1)
+
+    def block_b(t, p):
+        b3 = bconv(t, f"{p}.branch3x3", stride=2, same=False)
+        bd = bconv(bconv(bconv(t, f"{p}.branch3x3dbl_1"),
+                         f"{p}.branch3x3dbl_2"),
+                   f"{p}.branch3x3dbl_3", stride=2, same=False)
+        return torch.cat([b3, bd, F.max_pool2d(t, 3, 2)], 1)
+
+    def block_c(t, p):
+        b1 = bconv(t, f"{p}.branch1x1")
+        b7 = bconv(bconv(bconv(t, f"{p}.branch7x7_1"), f"{p}.branch7x7_2"),
+                   f"{p}.branch7x7_3")
+        bd = t
+        for i in range(1, 6):
+            bd = bconv(bd, f"{p}.branch7x7dbl_{i}")
+        bp = bconv(F.avg_pool2d(t, 3, 1, 1), f"{p}.branch_pool")
+        return torch.cat([b1, b7, bd, bp], 1)
+
+    def block_d(t, p):
+        b3 = bconv(bconv(t, f"{p}.branch3x3_1"), f"{p}.branch3x3_2",
+                   stride=2, same=False)
+        b7 = bconv(bconv(bconv(t, f"{p}.branch7x7x3_1"),
+                         f"{p}.branch7x7x3_2"), f"{p}.branch7x7x3_3")
+        b7 = bconv(b7, f"{p}.branch7x7x3_4", stride=2, same=False)
+        return torch.cat([b3, b7, F.max_pool2d(t, 3, 2)], 1)
+
+    def block_e(t, p):
+        b1 = bconv(t, f"{p}.branch1x1")
+        m3 = bconv(t, f"{p}.branch3x3_1")
+        b3 = torch.cat([bconv(m3, f"{p}.branch3x3_2a"),
+                        bconv(m3, f"{p}.branch3x3_2b")], 1)
+        md = bconv(bconv(t, f"{p}.branch3x3dbl_1"), f"{p}.branch3x3dbl_2")
+        bd = torch.cat([bconv(md, f"{p}.branch3x3dbl_3a"),
+                        bconv(md, f"{p}.branch3x3dbl_3b")], 1)
+        bp = bconv(F.avg_pool2d(t, 3, 1, 1), f"{p}.branch_pool")
+        return torch.cat([b1, b3, bd, bp], 1)
+
+    t = torch.from_numpy(np.transpose(x_nhwc, (0, 3, 1, 2))).double()
+    t = bconv(t, "Conv2d_1a_3x3", stride=2, same=False)
+    t = bconv(t, "Conv2d_2a_3x3", same=False)
+    t = bconv(t, "Conv2d_2b_3x3")
+    t = F.max_pool2d(t, 3, 2)
+    t = bconv(t, "Conv2d_3b_1x1", same=False)
+    t = bconv(t, "Conv2d_4a_3x3", same=False)
+    t = F.max_pool2d(t, 3, 2)
+    for p in ("Mixed_5b", "Mixed_5c", "Mixed_5d"):
+        t = block_a(t, p)
+    t = block_b(t, "Mixed_6a")
+    for p in ("Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e"):
+        t = block_c(t, p)
+    t = block_d(t, "Mixed_7a")
+    for p in ("Mixed_7b", "Mixed_7c"):
+        t = block_e(t, p)
+    t = t.mean(dim=(2, 3))
+    t = F.linear(t, tt("fc.weight"), tt("fc.bias"))
+    return t.numpy()
+
+
+def test_inception_v3_mapping_covers_every_leaf():
+    """The torchvision mapping addresses exactly the parametric leaves of
+    inception_v3(): 94 BasicConv2d (conv + 4 BN leaves each) + fc."""
+    from defer_tpu.models import inception_v3
+    from defer_tpu.utils.pretrained import inception_v3_torch_mapping
+
+    g = inception_v3()
+    expected = jax.eval_shape(lambda: g.init(jax.random.key(0)))
+    mapping = inception_v3_torch_mapping()
+    addressed = set(mapping)
+    parametric = {(node, leaf) for node, sub in expected.items()
+                  for leaf in sub}
+    assert addressed == parametric
+    assert len({src for src, _ in mapping.values()}) == 94 + 94 * 4 + 2
+
+
+@pytest.mark.slow
+def test_inception_v3_convert_and_logit_match():
+    """Full-architecture InceptionV3 conversion at 75 px: converted params
+    must reproduce the torch reference forward — validates builder order
+    vs the torchvision module tree, BN eps=1e-3, VALID/SAME placement,
+    and count_include_pad pool-branch semantics."""
+    torch = pytest.importorskip("torch")  # noqa: F841
+    from defer_tpu.models import inception_v3
+    from defer_tpu.utils.pretrained import (convert_state_dict,
+                                            inception_v3_torch_mapping)
+
+    g = inception_v3(num_classes=10, image_size=75)
+    expected = jax.eval_shape(lambda: g.init(jax.random.key(0)))
+    mapping = inception_v3_torch_mapping()
+    rng = np.random.default_rng(11)
+    sd = _random_sd_from_mapping(mapping, expected, rng)
+    # an ignored aux head must not break conversion
+    sd["AuxLogits.conv0.conv.weight"] = np.zeros((128, 768, 1, 1),
+                                                 np.float32)
+    params = convert_state_dict(mapping, sd, expected, "IV3-fixture")
+
+    x = rng.standard_normal((1, 75, 75, 3)).astype(np.float32)
+    ours = np.asarray(jax.jit(g.apply)(params, x), np.float64)
+    ref = _torch_inception_logits(sd, x)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
