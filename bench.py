@@ -288,10 +288,18 @@ def main():
 
     # ---- single-chip baseline + batch sweep (test/local_infer.py protocol)
     fwd = jax.jit(lambda p, x: graph.apply(p, x))
+    # fold_batchnorm and the pretrained loaders return HOST numpy params;
+    # device-commit the BASELINE copy once, or every single-chip fwd()
+    # call re-ships ~100 MB of weights through the tunnel (measured: 15x
+    # slower stepwise, the r5 fold-bn "regression" that wasn't one).
+    # `params` itself stays host-side: the pipeline packers np.asarray it.
     if compute_dtype is not None:
-        params_c = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+        # jnp.asarray casts on device for jax.Arrays and uploads-with-cast
+        # for host numpy — no gratuitous D2H either way
+        params_c = jax.tree.map(
+            lambda a: jnp.asarray(a, dtype=compute_dtype), params)
     else:
-        params_c = params
+        params_c = jax.device_put(params)
     x_dtype = compute_dtype or jnp.float32
 
     def mfu(ips):

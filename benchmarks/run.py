@@ -133,8 +133,13 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
         # baseline against a bf16 pipeline (inflating vs_baseline)
         x1 = x1.astype(compute_dtype)
     fwd = jax.jit(graph.apply)
-    params_c = (jax.tree.map(lambda a: a.astype(compute_dtype), params)
-                if compute_dtype else params)
+    # device-commit the BASELINE copy once (pretrained loaders return
+    # host numpy; per-call jit re-upload through the tunnel would make
+    # the baseline ~15x slower — the r5 fold-bn lesson).  `params`
+    # stays host-side for SpmdPipeline's packer.
+    params_c = (jax.tree.map(lambda a: jnp.asarray(a, dtype=compute_dtype),
+                             params)
+                if compute_dtype else jax.device_put(params))
     base_step_s = timed_window(
         lambda: jax.block_until_ready(fwd(params_c, x1)),
         min_s=2.0, max_iters=256) / microbatch

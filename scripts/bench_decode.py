@@ -12,7 +12,13 @@ lm_head).  The whole generation runs as ONE scan dispatch per
 ``token_chunk`` tokens, so the tunnel's ~64 ms/sync (PROFILE_r04.md) is
 paid once per chunk, not per token.
 
-Prints one JSON dict on stdout.
+Prints one JSON dict on stdout.  If ``DEFER_DECODE_OUT`` is set, the
+(partial) artifact is also rewritten after EVERY row — a wall-clock
+timeout then costs the remaining rows, not the whole run (the r4/r5
+lesson: the 30-row sweep once timed out at row 26 and left nothing).
+``DEFER_DECODE_ROWS`` (comma-separated substrings) restricts the sweep
+to matching row tags, e.g. ``DEFER_DECODE_ROWS=w8,mb64`` for a re-run
+of just the missing rows.
 """
 
 import json
@@ -92,10 +98,30 @@ def main():
         # the decode-side memory-bandwidth lever
         variants.append(("_w8", graph, params, "buffer", "int8"))
         variants.append(("_w8_int8kv", graph, params, "int8", "int8"))
+    from defer_tpu.utils.artifact import flush_artifact
+
+    row_filter = [s for s in os.environ.get("DEFER_DECODE_ROWS", ""
+                                            ).split(",") if s]
+    out_path = os.environ.get("DEFER_DECODE_OUT")
+
+    def flush_partial():
+        out["decode_sweep"] = sweep
+        out["token_chunk"] = token_chunk
+        out.setdefault("value", 0.0)
+        out["unit"] = "tokens/sec"
+        # merge keeps rows from a timed-out earlier run when re-running
+        # with DEFER_DECODE_ROWS over the same DEFER_DECODE_OUT; the
+        # headline value is recomputed over the merged rows
+        return flush_artifact(out_path, dict(out),
+                              merge_key="decode_sweep",
+                              merge_prior=bool(row_filter))
+
     for mb in mbs:
         for vtag, vgraph, vparams, vcache, vwq in variants:
             for use_prefill in ((False, True) if on_tpu else (False,)):
                 tag = f"mb{mb}{vtag}" + ("_prefill" if use_prefill else "")
+                if row_filter and not any(s in tag for s in row_filter):
+                    continue
                 try:
                     dec = PipelinedDecoder(vgraph, vparams, num_stages=1,
                                            microbatch=mb, max_len=max_len,
@@ -131,12 +157,9 @@ def main():
                 except Exception as e:  # noqa: BLE001 — OOM data point
                     sweep[tag] = {"error": repr(e)[:200]}
                     print(f"{tag}: {e!r}", file=sys.stderr, flush=True)
-    out["decode_sweep"] = sweep
-    out["token_chunk"] = token_chunk
-    ok = [v["tokens_per_s"] for v in sweep.values() if "tokens_per_s" in v]
-    out["value"] = max(ok) if ok else 0.0
-    out["unit"] = "tokens/sec"
-    print(json.dumps(out))
+                flush_partial()
+    final = flush_partial()
+    print(json.dumps(final))
 
 
 if __name__ == "__main__":

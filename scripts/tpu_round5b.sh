@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-5 follow-up TPU queue — runs after tpu_round5.sh drains.
+#
+# 1. Decode benchmark re-run: the first run timed out at row 26/30 and
+#    (pre-fix) left no artifact; bench_decode.py now rewrites
+#    DECODE_r05.json after every row, so even a timeout keeps the rows.
+# 2. XLA flag sweep for the MFU-ceiling hunt (VERDICT r4 #4).
+set -u
+cd "${1:-/root/repo}"
+
+echo "[r5b] $(date +%H:%M:%S) bench_decode (incremental) -> DECODE_r05.json" >&2
+DEFER_DECODE_OUT="$PWD/DECODE_r05.json" \
+    timeout 3600 python scripts/bench_decode.py > /tmp/decode_r05b.out \
+    2> /tmp/decode_r05b.err
+echo "[r5b] decode rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[r5b] $(date +%H:%M:%S) xla flag sweep -> XLA_SWEEP_r05.json" >&2
+DEFER_SWEEP_OUT="$PWD/XLA_SWEEP_r05.json" \
+    timeout 7200 python scripts/xla_flag_sweep.py > /tmp/xla_sweep.out \
+    2> /tmp/xla_sweep.err
+echo "[r5b] sweep rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[r5b] $(date +%H:%M:%S) speculative decode bench -> SPEC_r05.json" >&2
+DEFER_SPEC_OUT="$PWD/SPEC_r05.json" \
+    timeout 2400 python scripts/bench_spec.py > /tmp/spec_r05.out \
+    2> /tmp/spec_r05.err
+echo "[r5b] spec rc=$? $(date +%H:%M:%S)" >&2
+
+echo "[r5b] $(date +%H:%M:%S) fold-bn re-measure (device-committed params)" >&2
+DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_TIMEOUT_S=150 \
+    timeout 1500 python bench.py --quick \
+    > /tmp/bench_nofold2.json 2> /tmp/bench_nofold2.err
+echo "[r5b] nofold2 rc=$?" >&2
+DEFER_BENCH_REQUIRE_TPU=1 DEFER_BENCH_TPU_TIMEOUT_S=150 \
+    timeout 1500 python bench.py --quick --fold-bn \
+    > /tmp/bench_fold2.json 2> /tmp/bench_fold2.err
+echo "[r5b] fold2 rc=$? $(date +%H:%M:%S)" >&2
+python - <<'PYEOF' > FOLDBN_r05.json
+import json
+rows = {}
+for tag, path in (("baseline", "/tmp/bench_nofold2.json"),
+                  ("fold_bn", "/tmp/bench_fold2.json")):
+    try:
+        with open(path) as f:
+            d = json.loads(f.read().strip().splitlines()[-1])
+        rows[tag] = {"pipeline_img_per_s": d["value"],
+                     "single_chip_best_img_per_s":
+                         d["single_chip_best_img_per_s"],
+                     "flops_per_img": d["flops_per_img"]}
+    except Exception as e:  # noqa: BLE001
+        rows[tag] = {"error": repr(e)[:200]}
+print(json.dumps({"metric": "resnet50_fold_bn_comparison",
+                  "note": "re-measured after committing folded params "
+                          "to device (first run shipped host numpy "
+                          "weights through the tunnel per call)",
+                  **rows}))
+PYEOF
+echo "[r5b] foldbn artifact rewritten $(date +%H:%M:%S)" >&2

@@ -117,3 +117,51 @@ def test_hop_utilization_property():
     assert len(util) == 4
     assert util == [s.out_spec.size / pipe.buf_elems for s in stages]
     assert all(0 < u <= 1 for u in util)
+
+
+def test_flush_artifact_atomic_merge(tmp_path):
+    """Timeout-safe artifact writer: atomic write, row merge across a
+    re-run with a row filter, value recomputed over MERGED rows (the
+    DECODE_r05 clobber scenario)."""
+    import json
+    from defer_tpu.utils.artifact import flush_artifact
+
+    p = str(tmp_path / "a.json")
+    # run 1: 2 rows, then times out
+    flush_artifact(p, {"metric": "m", "value": 5.0,
+                       "rows": {"a": {"tokens_per_s": 5.0},
+                                "b": {"tokens_per_s": 3.0}}},
+                   merge_key="rows")
+    # run 2 (filtered re-run, merge_prior) measures only row c
+    got = flush_artifact(p, {"metric": "m", "value": 2.0,
+                             "rows": {"c": {"tokens_per_s": 2.0}}},
+                         merge_key="rows", merge_prior=True)
+    on_disk = json.loads(open(p).read())
+    assert set(on_disk["rows"]) == {"a", "b", "c"}
+    assert on_disk["value"] == 5.0  # max over merged, not just run 2
+    assert got == on_disk
+    # a FULL re-run (no merge_prior) replaces stale rows instead of
+    # letting an obsolete fast row own the headline
+    full = flush_artifact(p, {"metric": "m", "value": 0.0,
+                              "rows": {"a": {"tokens_per_s": 4.0}}},
+                          merge_key="rows")
+    assert set(full["rows"]) == {"a"} and full["value"] == 4.0
+    # row_filter restricts the headline (bench_spec: exclude baseline)
+    f = flush_artifact(None, {"value": 0.0,
+                              "rows": {"base": {"tokens_per_s": 9.0},
+                                       "spec_x": {"tokens_per_s": 2.0}}},
+                       merge_key="rows",
+                       row_filter=lambda k: k.startswith("spec_"))
+    assert f["value"] == 2.0
+    # empty prior file must not crash the flush (the touch/stray-redirect
+    # scenario)
+    e = str(tmp_path / "empty.json")
+    open(e, "w").close()
+    flush_artifact(e, {"value": 0.0, "rows": {"a": {"tokens_per_s": 1.0}}},
+                   merge_key="rows", merge_prior=True)
+    assert json.loads(open(e).read())["value"] == 1.0
+    # no .part file left behind
+    assert not [f for f in tmp_path.iterdir() if f.suffix == ".part"]
+    # no path -> no write, payload returned unchanged
+    r = flush_artifact(None, {"x": 1})
+    assert r == {"x": 1}
