@@ -29,6 +29,21 @@ ICI_BW_BYTES_S: dict[str, float] = {
     "v6e": 9.0e10,
 }
 
+#: HBM bandwidth, bytes/s (public spec-sheet numbers)
+HBM_BW_BYTES_S: dict[str, float] = {
+    "v2": 7.0e11,
+    "v3": 9.0e11,
+    "v4": 1.228e12,
+    "v5e": 8.19e11,
+    "v5p": 2.765e12,
+    "v6e": 1.64e12,
+}
+
+
+def hbm_bandwidth(gen: str) -> float:
+    """HBM bytes/s for a generation; 0.0 when unknown."""
+    return HBM_BW_BYTES_S.get(gen, 0.0)
+
 
 def identify_chip(device) -> str:
     """Generation string for a jax device, or "unknown".
