@@ -32,7 +32,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np  # noqa: F401  (kept for parity with sibling scripts)
+import numpy as np
 
 
 ELEMENTWISE = {"BatchNorm", "Activation", "Add", "ZeroPad2D", "LayerNorm",
@@ -46,12 +46,18 @@ def main():
     ap.add_argument("--gen", default="v5e")
     args = ap.parse_args()
 
+    import jax
+
     from defer_tpu import models
+    from defer_tpu.graph.analysis import node_flops
     from defer_tpu.utils.hw import hbm_bandwidth, peak_flops
 
     graph = getattr(models, args.model)()
     peak = peak_flops(args.gen)
     bw = hbm_bandwidth(args.gen)
+    if not peak or not bw:
+        raise SystemExit(f"unknown TPU generation {args.gen!r} "
+                         f"(no peak/bandwidth table entry)")
     b = args.batch
     bpe = 2  # bf16
 
@@ -61,11 +67,10 @@ def main():
     for name, node in graph.nodes.items():
         in_specs = tuple(graph.out_spec(i) for i in node.inputs)
         out = node.out_spec
-        fl = float(node.op.flops(in_specs, out)) * b
+        fl = float(node_flops(graph, name)) * b
         act_bytes = (sum(s.size for s in in_specs) + out.size) * b * bpe
         w_bytes = 0.0
         if node.param_spec:
-            import jax
             w_bytes = sum(float(np.prod(l.shape)) * bpe for l in
                           jax.tree.leaves(node.param_spec))
         kind = type(node.op).__name__
