@@ -291,7 +291,10 @@ def main():
     log(f"bench: model FLOPs/img = {flops_img / 1e9:.2f} G")
 
     # ---- single-chip baseline + batch sweep (test/local_infer.py protocol)
-    fwd = jax.jit(lambda p, x: graph.apply(p, x))
+    from defer_tpu.utils.xla_opts import compiler_options, jit_kwargs
+    if compiler_options():
+        log(f"bench: compiler_options = {compiler_options()}")
+    fwd = jax.jit(lambda p, x: graph.apply(p, x), **jit_kwargs())
     # fold_batchnorm and the pretrained loaders return HOST numpy params;
     # device-commit the BASELINE copy once, or every single-chip fwd()
     # call re-ships ~100 MB of weights through the tunnel (measured: 15x

@@ -337,7 +337,8 @@ class SpmdPipeline:
             out_specs=(bspec, ospec),
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(1,))
+        from ..utils.xla_opts import jit_kwargs
+        return jax.jit(fn, donate_argnums=(1,), **jit_kwargs())
 
     # ------------------------------------------------------------------
     # streaming interface

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+from functools import partial
 from typing import Any
 
 import jax
@@ -45,7 +46,9 @@ def amortized_forward_seconds(apply_fn, params, x0, k: int, *,
     from jax import lax
     import jax.numpy as jnp
 
-    @jax.jit
+    from .xla_opts import jit_kwargs
+
+    @partial(jax.jit, **jit_kwargs())
     def scan_fwd(p, x0, ts):
         def body(c, t):
             y = apply_fn(p, x0 + t)

@@ -1,0 +1,40 @@
+"""Env-driven XLA compiler options for the jit sites that matter.
+
+This environment's TPU is compiled through a remote relay: TPU-only
+``XLA_FLAGS`` die in the LOCAL client's flag parser before ever reaching
+the remote compiler (measured — XLA_SWEEP_r05.json round 1), but
+per-executable ``compiler_options`` ARE forwarded (probed: vmem limit,
+latency-hiding scheduler, async collective-permute all compile).  So
+flag experiments ride ``DEFER_XLA_COMPILER_OPTS`` instead:
+
+    DEFER_XLA_COMPILER_OPTS="xla_tpu_scoped_vmem_limit_kib=65536 \
+        xla_tpu_enable_latency_hiding_scheduler=true" python bench.py
+
+Space- or comma-separated ``key=value`` pairs; applied by the hot jit
+sites (SpmdPipeline's stage program, bench's baseline forwards).  Unset
+means exactly the default compile — the helper returns ``{}`` so call
+sites can splat it unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def compiler_options() -> dict[str, str]:
+    """Parsed ``DEFER_XLA_COMPILER_OPTS`` (empty dict when unset)."""
+    raw = os.environ.get("DEFER_XLA_COMPILER_OPTS", "").replace(",", " ")
+    out: dict[str, str] = {}
+    for tok in raw.split():
+        if "=" not in tok:
+            raise ValueError(
+                f"DEFER_XLA_COMPILER_OPTS entry {tok!r} is not key=value")
+        k, v = tok.split("=", 1)
+        out[k] = v
+    return out
+
+
+def jit_kwargs() -> dict:
+    """``{"compiler_options": {...}}`` or ``{}`` — splat into jax.jit."""
+    opts = compiler_options()
+    return {"compiler_options": opts} if opts else {}

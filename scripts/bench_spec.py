@@ -45,6 +45,16 @@ def main():
         tl, td, th = 12, 768, 12          # GPT-2-small target
         dl, dd, dh = 2, 256, 4            # cheap draft (~12% of target)
         vocab, max_len, plen, new, mb = 50257, 256, 32, 128, 8
+        # every proposed-but-rejected token costs a full-sequence forward
+        # through the tunnel; DEFER_SPEC_NEW trims the per-row round
+        # count for a bounded re-run window
+        new = int(os.environ.get("DEFER_SPEC_NEW", new))
+        if plen + new > max_len:
+            raise SystemExit(
+                f"DEFER_SPEC_NEW={new}: prompt {plen} + new {new} exceeds "
+                f"the decode buffer max_len {max_len}")
+        gammas = tuple(int(g) for g in os.environ.get(
+            "DEFER_SPEC_GAMMAS", "1,3,5").split(","))
         cd = "bfloat16"
     else:  # CPU smoke
         tl, td, th = 4, 64, 2
@@ -125,7 +135,7 @@ def main():
               f"tf={stats['target_forwards']}", file=sys.stderr, flush=True)
         flush()
 
-    for gamma in (1, 3, 5) if on_tpu else (3,):
+    for gamma in gammas if on_tpu else (3,):
         spec_row(f"spec_floor_g{gamma}", draft, dparams, gamma)
     spec_row("spec_perfect_g3", target, tparams, 3)
 

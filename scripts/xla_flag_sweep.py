@@ -1,9 +1,13 @@
 """XLA flag sweep for the MFU-ceiling hunt (VERDICT r4 #4).
 
-XLA_FLAGS are read once at backend init, so each flag set gets its own
-``bench.py`` subprocess (focused config: the best-known batch/chunk/
-microbatch from r4).  Flags probed are the documented TPU performance
-levers relevant to a conv-dominated pipelined workload:
+Each flag set gets its own ``bench.py`` subprocess (focused config: the
+best-known batch/chunk/microbatch).  Flags travel via
+``DEFER_XLA_COMPILER_OPTS`` -> per-executable ``compiler_options``, NOT
+``XLA_FLAGS``: this chip compiles through a remote relay whose LOCAL
+client rejects TPU-only XLA_FLAGS at parse time (round-1 sweep failed
+exactly so), while compiler_options are forwarded (probed).  Flags
+probed are the documented TPU performance levers relevant to a
+conv-dominated pipelined workload:
 
 - ``scoped_vmem_limit_kib``: more VMEM headroom for fusions (less HBM
   spill between the conv and its fused elementwise epilogue);
@@ -31,13 +35,13 @@ sys.path.insert(0, REPO)
 
 FLAG_SETS = {
     "baseline": "",
-    "vmem64m": "--xla_tpu_scoped_vmem_limit_kib=65536",
-    "lhs": "--xla_tpu_enable_latency_hiding_scheduler=true",
-    "async_cp": "--xla_enable_async_collective_permute=true",
-    "lhs+async_cp": ("--xla_tpu_enable_latency_hiding_scheduler=true "
-                     "--xla_enable_async_collective_permute=true"),
-    "vmem64m+lhs": ("--xla_tpu_scoped_vmem_limit_kib=65536 "
-                    "--xla_tpu_enable_latency_hiding_scheduler=true"),
+    "vmem64m": "xla_tpu_scoped_vmem_limit_kib=65536",
+    "lhs": "xla_tpu_enable_latency_hiding_scheduler=true",
+    "async_cp": "xla_enable_async_collective_permute=true",
+    "lhs+async_cp": ("xla_tpu_enable_latency_hiding_scheduler=true "
+                     "xla_enable_async_collective_permute=true"),
+    "vmem64m+lhs": ("xla_tpu_scoped_vmem_limit_kib=65536 "
+                    "xla_tpu_enable_latency_hiding_scheduler=true"),
 }
 
 
@@ -59,7 +63,7 @@ def main():
     for name, flags in FLAG_SETS.items():
         p = None
         env = dict(os.environ)
-        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+        env["DEFER_XLA_COMPILER_OPTS"] = flags
         env["DEFER_BENCH_REQUIRE_TPU"] = "1"
         env.setdefault("DEFER_BENCH_TPU_TIMEOUT_S", "150")
         t0 = time.time()
