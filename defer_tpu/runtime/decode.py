@@ -59,6 +59,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..graph.ir import LayerGraph
 from ..models.gpt import CausalTransformerBlock, GptEmbedding
 from ..parallel.mesh import STAGE_AXIS, pipeline_mesh
+from ..utils.xla_opts import ring_jit_kwargs
 from . import flatbuf
 
 
@@ -573,7 +574,8 @@ class PipelinedDecoder:
             out_specs=(state, P(STAGE_AXIS, None, None)),
             check_vma=False,
         )
-        return jax.jit(fn, donate_argnums=(4,))
+        return jax.jit(fn, donate_argnums=(4,),
+                       **ring_jit_kwargs(self.mesh.devices))
 
     def _init_state(self):
         """Fresh sharded pipeline state: ring carry + empty KV caches.
@@ -661,7 +663,8 @@ class PipelinedDecoder:
             check_vma=False,
         )
         # donate the carried state so chunked dispatches update in place
-        return jax.jit(fn, donate_argnums=(10, 11))
+        return jax.jit(fn, donate_argnums=(10, 11),
+                       **ring_jit_kwargs(self.mesh.devices))
 
     # ------------------------------------------------------------------
 

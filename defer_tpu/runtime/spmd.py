@@ -47,6 +47,7 @@ from ..graph.ir import ShapeSpec
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS, pipeline_mesh
 from ..partition.stage import StageSpec, buffer_footprint
 from ..utils.metrics import PipelineMetrics
+from ..utils.xla_opts import ring_jit_kwargs
 from . import flatbuf
 
 
@@ -337,8 +338,8 @@ class SpmdPipeline:
             out_specs=(bspec, ospec),
             check_vma=False,
         )
-        from ..utils.xla_opts import jit_kwargs
-        return jax.jit(fn, donate_argnums=(1,), **jit_kwargs())
+        return jax.jit(fn, donate_argnums=(1,),
+                       **ring_jit_kwargs(self.mesh.devices))
 
     # ------------------------------------------------------------------
     # streaming interface

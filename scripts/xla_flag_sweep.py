@@ -33,15 +33,17 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+#: async collective_permute is now the pipeline's TPU DEFAULT
+#: (utils/xla_opts.RING_DEFAULTS, adopted off this sweep's r5 result),
+#: so the control row must switch it OFF explicitly — a bare env no
+#: longer isolates flags.
 FLAG_SETS = {
-    "baseline": "",
-    "vmem64m": "xla_tpu_scoped_vmem_limit_kib=65536",
-    "lhs": "xla_tpu_enable_latency_hiding_scheduler=true",
-    "async_cp": "xla_enable_async_collective_permute=true",
-    "lhs+async_cp": ("xla_tpu_enable_latency_hiding_scheduler=true "
-                     "xla_enable_async_collective_permute=true"),
-    "vmem64m+lhs": ("xla_tpu_scoped_vmem_limit_kib=65536 "
-                    "xla_tpu_enable_latency_hiding_scheduler=true"),
+    "no_async_cp": "xla_enable_async_collective_permute=false",
+    "default": "",
+    "default+vmem64m": "xla_tpu_scoped_vmem_limit_kib=65536",
+    "default+lhs": "xla_tpu_enable_latency_hiding_scheduler=true",
+    "default+lhs+vmem64m": ("xla_tpu_enable_latency_hiding_scheduler=true "
+                            "xla_tpu_scoped_vmem_limit_kib=65536"),
 }
 
 
