@@ -177,7 +177,8 @@ def emit_unavailable_and_exit(diag: str):
     """
     last_good = None
     here = os.path.dirname(os.path.abspath(__file__))
-    for name in ("BENCH_r04_builder.json", "BENCH_r03.json"):
+    for name in ("BENCH_r05_best.json", "BENCH_r05_builder.json",
+                 "BENCH_r04_builder.json", "BENCH_r03.json"):
         try:
             with open(os.path.join(here, name)) as f:
                 prev = json.load(f)
