@@ -165,3 +165,36 @@ def test_flush_artifact_atomic_merge(tmp_path):
     # no path -> no write, payload returned unchanged
     r = flush_artifact(None, {"x": 1})
     assert r == {"x": 1}
+
+
+def test_ring_jit_kwargs_contract(monkeypatch):
+    """Ring programs get the TPU defaults only on non-CPU meshes; env
+    options merge over (and can disable) the defaults; CPU meshes never
+    receive TPU-only flags implicitly."""
+    import numpy as np
+    import jax
+    from defer_tpu.utils.xla_opts import (RING_DEFAULTS, compiler_options,
+                                          jit_kwargs, ring_jit_kwargs)
+
+    cpu_devices = np.array(jax.devices())  # conftest pins the cpu backend
+    monkeypatch.delenv("DEFER_XLA_COMPILER_OPTS", raising=False)
+    assert ring_jit_kwargs(cpu_devices) == {}
+    assert jit_kwargs() == {}
+
+    monkeypatch.setenv("DEFER_XLA_COMPILER_OPTS", "a=1, b=two")
+    assert compiler_options() == {"a": "1", "b": "two"}
+    assert ring_jit_kwargs(cpu_devices) == {
+        "compiler_options": {"a": "1", "b": "two"}}
+
+    class FakeTpu:
+        platform = "tpu"
+
+    tpu_devices = [FakeTpu()]
+    opts = ring_jit_kwargs(tpu_devices)["compiler_options"]
+    assert opts["a"] == "1"
+    for k, v in RING_DEFAULTS.items():
+        assert opts[k] == v
+    # env overrides a default key-by-key
+    key = next(iter(RING_DEFAULTS))
+    monkeypatch.setenv("DEFER_XLA_COMPILER_OPTS", f"{key}=false")
+    assert ring_jit_kwargs(tpu_devices)["compiler_options"][key] == "false"
