@@ -10,7 +10,7 @@ Each family ships a ``*_tiny`` variant for fast CPU-mesh tests.
 """
 
 from .bert import BERT_BASE_12STAGE_CUTS, bert, bert_base, bert_tiny
-from .gpt import gpt, gpt_small, gpt_stage_cuts, gpt_tiny
+from .gpt import gpt, gpt2_small, gpt_small, gpt_stage_cuts, gpt_tiny
 from .moe import moe_stage_cuts, moe_tiny, moe_transformer
 from .inception import (INCEPTION_6STAGE_CUTS, inception, inception_tiny,
                         inception_v3)
@@ -24,6 +24,6 @@ __all__ = [
     "inception", "inception_v3", "inception_tiny", "INCEPTION_6STAGE_CUTS",
     "mobilenet_v2", "mobilenet_tiny", "MOBILENETV2_2STAGE_CUTS",
     "bert", "bert_base", "bert_tiny", "BERT_BASE_12STAGE_CUTS",
-    "gpt", "gpt_small", "gpt_tiny", "gpt_stage_cuts",
+    "gpt", "gpt2_small", "gpt_small", "gpt_tiny", "gpt_stage_cuts",
     "moe_transformer", "moe_tiny", "moe_stage_cuts",
 ]

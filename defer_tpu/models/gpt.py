@@ -165,7 +165,7 @@ class CausalTransformerBlock(TransformerBlock):
         cache_len = k_cache.shape[2]
         quant = k_scale is not None
 
-        y = self._ln(p["ln1"], x)
+        y = self._ln(p["ln1"], x, self.ln_eps)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
         q, k_new, v_new = self._split_qkv(qkv)
         k_row = k_new.reshape(b, kv, 1, hd)
@@ -194,7 +194,7 @@ class CausalTransformerBlock(TransformerBlock):
         y = jnp.einsum("bkgl,bkld->bkgd", att, vh).reshape(b, d)
         x = x + (y @ p["proj"]["w"] + p["proj"]["b"])
 
-        y = self._ln(p["ln2"], x)
+        y = self._ln(p["ln2"], x, self.ln_eps)
         y = jax.nn.gelu(y @ p["fc1"]["w"] + p["fc1"]["b"])
         out = x + (y @ p["fc2"]["w"] + p["fc2"]["b"])
         if quant:
@@ -237,21 +237,24 @@ class GptEmbedding(Op):
 
 def gpt(num_layers: int, hidden: int, heads: int, seq_len: int,
         vocab: int = 50257, kv_heads: int | None = None,
-        name: str = "gpt") -> LayerGraph:
+        ln_eps: float = 1e-6, name: str = "gpt") -> LayerGraph:
     """Causal LM graph: ids [t] -> logits [t, vocab].
 
     ``block_k`` nodes are the pipeline cut points; the decode engine
     (:mod:`defer_tpu.runtime.decode`) consumes the same graph by node-name
     contract: ``embeddings``, ``block_0..``, ``final_ln``, ``lm_head``.
-    ``kv_heads`` < ``heads`` builds a GQA model (MQA at 1).
+    ``kv_heads`` < ``heads`` builds a GQA model (MQA at 1).  ``ln_eps``
+    is threaded through every block and the final LayerNorm — HF GPT-2
+    checkpoints were trained at 1e-5 (see :func:`gpt2_small`).
     """
     b = GraphBuilder(name)
     x = b.input((seq_len,), jnp.int32)
     x = b.add(GptEmbedding(vocab, hidden, seq_len), x, name="embeddings")
     for i in range(num_layers):
-        x = b.add(CausalTransformerBlock(heads, num_kv_heads=kv_heads),
+        x = b.add(CausalTransformerBlock(heads, num_kv_heads=kv_heads,
+                                         ln_eps=ln_eps),
                   x, name=f"block_{i}")
-    x = b.add(LayerNorm(), x, name="final_ln")
+    x = b.add(LayerNorm(eps=ln_eps), x, name="final_ln")
     x = b.add(Dense(vocab), x, name="lm_head")
     return b.build()
 
@@ -259,6 +262,14 @@ def gpt(num_layers: int, hidden: int, heads: int, seq_len: int,
 def gpt_small(seq_len: int = 256, kv_heads: int | None = None) -> LayerGraph:
     """GPT-2 small geometry (12 layers, d=768, 12 heads)."""
     return gpt(12, 768, 12, seq_len, kv_heads=kv_heads, name="gpt_small")
+
+
+def gpt2_small(seq_len: int = 256) -> LayerGraph:
+    """HF-faithful GPT-2 small: same geometry as :func:`gpt_small` but
+    with GPT-2's trained LN epsilon (1e-5), so ``gpt2`` checkpoints
+    (``utils/pretrained.py: load_pretrained_gpt2``) reproduce HF logits.
+    """
+    return gpt(12, 768, 12, seq_len, ln_eps=1e-5, name="gpt2_small")
 
 
 def gpt_tiny(seq_len: int = 16, vocab: int = 97,

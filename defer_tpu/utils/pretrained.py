@@ -487,6 +487,79 @@ def load_pretrained_mobilenet_v2(path: str, graph: LayerGraph | None = None
     return load_params(path, expected)
 
 
+def _crop_rows(n: int) -> Callable[[np.ndarray], np.ndarray]:
+    def t(a: np.ndarray) -> np.ndarray:
+        return a[:n]
+    t.__name__ = "_crop_rows"
+    return t
+
+
+def gpt2_torch_mapping(num_layers: int, max_len: int
+                       ) -> dict[tuple[str, str], tuple[str, Callable]]:
+    """(our_node, our_leaf) -> (HF GPT-2 key, transform) for
+    ``models.gpt.gpt``-family graphs (``gpt2_small`` for checkpoints).
+
+    HF GPT-2 uses Conv1D modules whose weights are stored ``[in, out]``
+    — exactly this framework's layout — so every projection maps with
+    ``_ident`` (no transposes, unlike the torchvision CNN imports).  The
+    fused ``attn.c_attn`` packs q|k|v along columns in the same order as
+    our fused qkv split.  The LM head is weight-tied to ``wte`` in HF
+    (logits = x @ wte.T): our untied ``lm_head`` imports ``wte.T`` with
+    a zero bias.  The positional table is cropped to the graph's
+    ``seq_len`` (HF ships 1024 rows).
+    """
+    m: dict[tuple[str, str], tuple[str, Callable]] = {
+        ("embeddings", "wte"): ("wte.weight", _ident),
+        ("embeddings", "wpe"): ("wpe.weight", _crop_rows(max_len)),
+        ("final_ln", "scale"): ("ln_f.weight", _ident),
+        ("final_ln", "bias"): ("ln_f.bias", _ident),
+        ("lm_head", "w"): ("wte.weight", _fc_t),  # tied head: wte.T
+        ("lm_head", "b"): ("wte.weight", _zero_rows),
+    }
+    for i in range(num_layers):
+        h = f"h.{i}"
+        blk = f"block_{i}"
+        for ours, theirs in (("ln1", "ln_1"), ("ln2", "ln_2")):
+            m[(blk, f"{ours}/scale")] = (f"{h}.{theirs}.weight", _ident)
+            m[(blk, f"{ours}/bias")] = (f"{h}.{theirs}.bias", _ident)
+        for ours, theirs in (("qkv", "attn.c_attn"), ("proj", "attn.c_proj"),
+                             ("fc1", "mlp.c_fc"), ("fc2", "mlp.c_proj")):
+            m[(blk, f"{ours}/w")] = (f"{h}.{theirs}.weight", _ident)
+            m[(blk, f"{ours}/b")] = (f"{h}.{theirs}.bias", _ident)
+    return m
+
+
+def _zero_rows(a: np.ndarray) -> np.ndarray:
+    """Zero bias sized by the source's leading dim (tied-head import)."""
+    return np.zeros((a.shape[0],), np.float32)
+
+
+def load_pretrained_gpt2(path: str, graph: LayerGraph | None = None
+                         ) -> dict[str, Any]:
+    """Load an HF GPT-2 checkpoint (``GPT2Model``/``GPT2LMHeadModel``
+    state_dict, optionally ``transformer.``-prefixed) or our flat layout.
+
+    No reference analogue (the reference is CNN-only); this extends the
+    trained-deployment story (reference test/test.py:13-14) to the
+    generation family: imported weights drive ``PipelinedDecoder`` /
+    ``Defer.generate`` directly.
+    """
+    if graph is None:
+        from ..models import gpt2_small
+        graph = gpt2_small()
+    expected = _expected_shapes(graph)
+    sd = _read_state_dict(path)
+    sd = {(k[len("transformer."):] if k.startswith("transformer.") else k): v
+          for k, v in sd.items()}
+    if any(k.startswith("h.0.") or k == "wte.weight" for k in sd):
+        layers = sum(1 for node in expected if node.startswith("block_"))
+        max_len = graph.input_spec.shape[0]
+        return convert_state_dict(gpt2_torch_mapping(layers, max_len), sd,
+                                  expected, "GPT-2")
+    from .checkpoint import load_params
+    return load_params(path, expected)
+
+
 def load_pretrained_inception_v3(path: str, graph: LayerGraph | None = None
                                  ) -> dict[str, Any]:
     """Load an InceptionV3 checkpoint (torchvision or our flat layout).
@@ -516,6 +589,7 @@ PRETRAINED_LOADERS: dict[str, Callable] = {
     "mobilenet_v2": load_pretrained_mobilenet_v2,
     "bert_base": load_pretrained_bert_base,
     "inception_v3": load_pretrained_inception_v3,
+    "gpt2": load_pretrained_gpt2,
 }
 
 
