@@ -56,7 +56,9 @@ timeout 1200 python scripts/profile_resnet_ops.py > PROFILE_OPS_r05.json.tmp \
 echo "[r5queue] profile rc=$? $(date +%H:%M:%S)" >&2
 
 echo "[r5queue] $(date +%H:%M:%S) five-config suite" >&2
-timeout 2400 python benchmarks/run.py > BENCHMARKS_r05.json.tmp \
+timeout 2400 python benchmarks/run.py \
+    --weights-dir "${DEFER_WEIGHTS_DIR:-/root/weights}" \
+    > BENCHMARKS_r05.json.tmp \
     2> /tmp/benchmarks_r05.err \
   && mv BENCHMARKS_r05.json.tmp BENCHMARKS_r05.json
 echo "[r5queue] done $(date +%H:%M:%S)" >&2
