@@ -533,25 +533,43 @@ class TransformerBlock(Op):
     # -- tensor parallelism: Megatron column->row pairing, heads sharded ---
 
     def tp_shard(self, params, tp, rank):
-        if self.num_heads % tp:
+        nh, kv = self.num_heads, self._kv_head_count()
+        if nh % tp or kv % tp:
             raise ValueError(
-                f"num_heads={self.num_heads} not divisible by tp={tp}")
+                f"heads={nh}/kv_heads={kv} not divisible by tp={tp} "
+                f"(each rank must hold whole query groups)")
+        d = params["qkv"]["w"].shape[0]
+        hd = d // nh
+        blk = d // tp                 # query columns per rank
+        kvblk = (kv // tp) * hd       # K (and V) columns per rank
+        # fused layout: [q (nh*hd) | k (kv*hd) | v (kv*hd)]; kv == nh
+        # reduces to the classic Megatron equal-thirds slice
+        q0, k0, v0 = 0, d, d + kv * hd
+
+        def qkv_cols(a):
+            # per-chunk column slice so each rank gets whole (query) heads
+            return jnp.concatenate(
+                [a[..., q0 + rank * blk: q0 + (rank + 1) * blk],
+                 a[..., k0 + rank * kvblk: k0 + (rank + 1) * kvblk],
+                 a[..., v0 + rank * kvblk: v0 + (rank + 1) * kvblk]],
+                axis=-1)
+
+        return {
+            "qkv": {"w": qkv_cols(params["qkv"]["w"]),
+                    "b": qkv_cols(params["qkv"]["b"])},
+            **self._tp_shard_common(params, tp, rank),
+        }
+
+    def _tp_shard_common(self, params, tp, rank):
+        """The non-qkv Megatron shards (LNs replicated, proj rows, MLP
+        column->row pair) — shared by the MHA and GQA qkv schemes."""
         d = params["qkv"]["w"].shape[0]
         h = params["fc1"]["w"].shape[1]
         if h % tp:
             raise ValueError(f"mlp width {h} not divisible by tp={tp}")
         blk, hblk = d // tp, h // tp
-
-        def qkv_cols(a):
-            # per-chunk (q,k,v) column slice so each rank gets whole heads
-            parts = [a[..., i * d + rank * blk: i * d + (rank + 1) * blk]
-                     for i in range(3)]
-            return jnp.concatenate(parts, axis=-1)
-
         return {
             "ln1": params["ln1"],
-            "qkv": {"w": qkv_cols(params["qkv"]["w"]),
-                    "b": qkv_cols(params["qkv"]["b"])},
             "proj": {"w": params["proj"]["w"][rank * blk:(rank + 1) * blk],
                      "b": params["proj"]["b"]},
             "ln2": params["ln2"],
@@ -566,18 +584,25 @@ class TransformerBlock(Op):
             return self.apply(params, x)
         p = _cast(params, x.dtype)
         b, t, d = x.shape
-        nh = self.num_heads // tp           # local heads
-        dl = p["qkv"]["w"].shape[1] // 3    # local head-group width d/tp
-        hd = dl // nh
+        nh = self.num_heads // tp           # local query heads
+        kvl = self._kv_head_count() // tp   # local KV heads (GQA: fewer)
+        hd = d // self.num_heads
+        dl = nh * hd                        # local query width d/tp
         eps = self.ln_eps
         post = self.norm == "post"          # mirror apply_with_kv exactly
 
         y = x if post else self._ln(p["ln1"], x, eps)
         qkv = y @ p["qkv"]["w"] + p["qkv"]["b"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = qkv[..., :dl]
+        k = qkv[..., dl: dl + kvl * hd]
+        v = qkv[..., dl + kvl * hd:]
         q = q.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, t, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, kvl, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, kvl, hd).transpose(0, 2, 1, 3)
+        if kvl != nh:
+            # broadcast each local KV head over its query group
+            k = jnp.repeat(k, nh // kvl, axis=1)
+            v = jnp.repeat(v, nh // kvl, axis=1)
         y = self._attend(q, k, v)
         y = y.transpose(0, 2, 1, 3).reshape(b, t, dl)
         y = lax.psum(y @ p["proj"]["w"], axis_name) + p["proj"]["b"]

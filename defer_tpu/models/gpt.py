@@ -90,13 +90,6 @@ class CausalTransformerBlock(TransformerBlock):
         return (2 * t * d * (qkv_cols + d + 2 * self.mlp_ratio * d)
                 + 4 * t * t * d)
 
-    def tp_shard(self, params, tp, rank):
-        if self.kv_heads != self.num_heads:
-            raise NotImplementedError(
-                "GQA blocks do not support tensor parallelism yet (the "
-                "Megatron qkv column split assumes equal head groups)")
-        return super().tp_shard(params, tp, rank)
-
     def _attend(self, q, k, v):
         impl = self.attn_impl
         if impl == "auto":

@@ -639,8 +639,10 @@ def test_gqa_param_shapes():
     blk = CausalTransformerBlock(4, num_kv_heads=2)
     p = blk.init(jax.random.key(0), (ShapeSpec((6, 32)),))
     assert p["qkv"]["w"].shape == (32, 32 + 2 * 2 * 8)  # d + 2*kv*hd
-    with pytest.raises(NotImplementedError, match="tensor parallelism"):
-        blk.tp_shard(p, 2, 0)
+    # GQA tensor parallelism (added r5): each rank holds whole query
+    # groups — nh/tp query cols + kv/tp KV cols each for K and V
+    shard = blk.tp_shard(p, 2, 0)
+    assert shard["qkv"]["w"].shape == (32, 16 + 2 * 8)
 
 
 def test_repeat_generate_reuses_compiled_program(model, prompt):

@@ -74,3 +74,45 @@ def test_tp_indivisible_heads_raises():
     params = graph.init(jax.random.key(0))
     with pytest.raises(ValueError, match="not divisible"):
         graph.nodes["block_0"].op.tp_shard(params["block_0"], 3, 0)
+
+
+@pytest.mark.parametrize("tp,kv", [(2, 2), (2, 4), (4, 4)])
+def test_gpt_gqa_tp_matches_full(tp, kv):
+    """GQA causal blocks under Megatron TP: each rank holds whole query
+    groups (nh/tp query heads, kv/tp KV heads); the sharded forward must
+    equal the unsharded one.  heads=8 > kv everywhere, so every case
+    exercises the GQA (not MHA) shard/apply paths, including tp=4."""
+    from defer_tpu.models.gpt import gpt
+
+    graph = gpt(2, 32, 8, 12, vocab=64, kv_heads=kv,
+                name=f"gqa_tp{tp}_kv{kv}")
+    params = graph.init(jax.random.key(5))
+    ids = np.arange(2 * 12).reshape(2, 12) % 64
+
+    ref = graph.apply(params, jnp.asarray(ids, jnp.int32))
+    mesh = tensor_parallel_mesh(tp)
+    stk = shard_tp_params(graph, params, tp, mesh=mesh)
+    out = tensor_parallel_fn(graph, mesh)(stk, jnp.asarray(ids, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_gqa_tp_indivisible_kv_raises():
+    from defer_tpu.models.gpt import gpt
+
+    graph = gpt(1, 32, 4, 8, vocab=32, kv_heads=2, name="gqa_bad_tp")
+    params = graph.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="not divisible"):
+        graph.nodes["block_0"].op.tp_shard(params["block_0"], 4, 0)
+
+
+def test_gpt_gqa_tp_shards_are_disjoint():
+    from defer_tpu.models.gpt import gpt
+
+    graph = gpt(1, 32, 4, 8, vocab=32, kv_heads=2, name="gqa_shard")
+    params = graph.init(jax.random.key(0))
+    full = params["block_0"]
+    tp = 2
+    blk = graph.nodes["block_0"].op.tp_shard(full, tp, 0)
+    assert blk["qkv"]["w"].shape[1] * tp == full["qkv"]["w"].shape[1]
+    assert blk["proj"]["w"].shape[0] * tp == full["proj"]["w"].shape[0]
