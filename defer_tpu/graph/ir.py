@@ -93,6 +93,11 @@ class Op:
         del axis_name, tp
         return self.apply(params, *xs)
 
+    def tp_unshard(self, shards: list[Params]) -> Params:
+        """Inverse of :meth:`tp_shard`: all ranks' shards -> full params.
+        Default (replicated params): every rank holds the full copy."""
+        return shards[0]
+
     def __repr__(self):
         return type(self).__name__
 

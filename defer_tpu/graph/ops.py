@@ -100,6 +100,12 @@ class Dense(Op):
             y = y + p["b"]
         return y
 
+    def tp_unshard(self, shards):
+        out = {"w": jnp.concatenate([s["w"] for s in shards], axis=0)}
+        if self.use_bias:
+            out["b"] = shards[0]["b"]  # replicated
+        return out
+
 
 @dataclasses.dataclass(frozen=True, repr=False)
 class Conv2D(Op):
@@ -577,6 +583,41 @@ class TransformerBlock(Op):
                     "b": params["fc1"]["b"][rank * hblk:(rank + 1) * hblk]},
             "fc2": {"w": params["fc2"]["w"][rank * hblk:(rank + 1) * hblk],
                     "b": params["fc2"]["b"]},
+        }
+
+    def tp_unshard(self, shards):
+        """Inverse of :meth:`tp_shard`: concatenate each rank's query/K/V
+        column groups back into the fused layout, proj/fc2 rows and fc1
+        columns back to full width; LNs and biases are replicated."""
+        tp = len(shards)
+        nh, kv = self.num_heads, self._kv_head_count()
+        d = shards[0]["proj"]["w"].shape[1]
+        hd = d // nh
+        blk, kvblk = d // tp, (kv // tp) * hd
+
+        def qkv_cat(key):
+            qs, ks, vs = [], [], []
+            for sh in shards:
+                a = sh["qkv"][key]
+                qs.append(a[..., :blk])
+                ks.append(a[..., blk: blk + kvblk])
+                vs.append(a[..., blk + kvblk:])
+            return jnp.concatenate(qs + ks + vs, axis=-1)
+
+        return {
+            "ln1": shards[0]["ln1"],
+            "qkv": {"w": qkv_cat("w"), "b": qkv_cat("b")},
+            "proj": {"w": jnp.concatenate(
+                [sh["proj"]["w"] for sh in shards], axis=0),
+                "b": shards[0]["proj"]["b"]},
+            "ln2": shards[0]["ln2"],
+            "fc1": {"w": jnp.concatenate(
+                [sh["fc1"]["w"] for sh in shards], axis=1),
+                "b": jnp.concatenate(
+                    [sh["fc1"]["b"] for sh in shards], axis=0)},
+            "fc2": {"w": jnp.concatenate(
+                [sh["fc2"]["w"] for sh in shards], axis=0),
+                "b": shards[0]["fc2"]["b"]},
         }
 
     def tp_apply(self, params, x, *, axis_name=None, tp=1):

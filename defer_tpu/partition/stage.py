@@ -74,6 +74,14 @@ class StageSpec:
         return {n: self.graph.nodes[n].op.tp_shard(sp[n], tp, rank)
                 for n in sp}
 
+    def tp_unshard_params(self, rank_params: "list[dict[str, Any]]"
+                          ) -> dict[str, Any]:
+        """Inverse of :meth:`tp_shard_params`: all ranks' stage shards ->
+        the stage's full parameters (op-specific reassembly)."""
+        return {n: self.graph.nodes[n].op.tp_unshard(
+                    [rp[n] for rp in rank_params])
+                for n in rank_params[0]}
+
     def __repr__(self):
         return (f"StageSpec({self.index}: {self.input_name} -> "
                 f"{self.output_name}, {len(self.node_names)} nodes, "

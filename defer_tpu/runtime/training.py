@@ -289,18 +289,25 @@ class PipelineTrainer:
         """The deployment's CURRENT weights as a standard graph parameter
         pytree (the inverse of the buffer staging) — restore-anywhere
         interop with ``utils.checkpoint`` / fresh deployments.  Leaves
-        come back in their original dtypes.  tp>1 raises (shard
-        reassembly is op-specific)."""
+        come back in their original dtypes.  Under tensor parallelism the
+        per-rank shards are reassembled op-by-op (``Op.tp_unshard``, the
+        inverse of the Megatron column/row splits)."""
         pipe = self.pipe
-        if pipe.tensor_parallel > 1:
-            raise NotImplementedError(
-                "trained_params reassembly under tensor parallelism")
+        tp = pipe.tensor_parallel
         w = np.asarray(pipe._w)
         params: dict[str, Any] = {}
         for k, s in enumerate(pipe.stages):
-            leaves = [w[k, off: off + size].reshape(shape).astype(dtype)
-                      for off, size, shape, dtype in pipe._wmeta[k]]
-            params.update(jax.tree.unflatten(pipe._wtreedef[k], leaves))
+            def unpack(row):
+                return [row[off: off + size].reshape(shape).astype(dtype)
+                        for off, size, shape, dtype in pipe._wmeta[k]]
+            if tp > 1:
+                rank_params = [
+                    jax.tree.unflatten(pipe._wtreedef[k], unpack(w[k, r]))
+                    for r in range(tp)]
+                params.update(s.tp_unshard_params(rank_params))
+            else:
+                params.update(jax.tree.unflatten(pipe._wtreedef[k],
+                                                 unpack(w[k])))
         return params
 
     def save_checkpoint(self, path: str):

@@ -116,3 +116,23 @@ def test_gpt_gqa_tp_shards_are_disjoint():
     blk = graph.nodes["block_0"].op.tp_shard(full, tp, 0)
     assert blk["qkv"]["w"].shape[1] * tp == full["qkv"]["w"].shape[1]
     assert blk["proj"]["w"].shape[0] * tp == full["proj"]["w"].shape[0]
+
+
+@pytest.mark.parametrize("kv,tp", [(2, 2), (4, 2), (8, 2), (4, 4)])
+def test_tp_unshard_inverts_tp_shard(kv, tp):
+    """Exact inversion for MHA (kv==nh) and GQA: reassembling all ranks'
+    shards must reproduce every leaf bit-for-bit — which also proves the
+    per-rank column slices are disjoint and correctly offset (identical
+    or mis-offset slices cannot reassemble to the original)."""
+    from defer_tpu.models.gpt import CausalTransformerBlock
+    from defer_tpu.graph.ir import ShapeSpec
+
+    blk = CausalTransformerBlock(8, num_kv_heads=kv)
+    p = blk.init(jax.random.key(4), (ShapeSpec((6, 32)),))
+    shards = [blk.tp_shard(p, tp, r) for r in range(tp)]
+    back = blk.tp_unshard(shards)
+    flat_b, td_b = jax.tree.flatten(back)
+    flat_p, td_p = jax.tree.flatten(p)
+    assert td_b == td_p
+    for a, b in zip(flat_b, flat_p):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
