@@ -172,8 +172,15 @@ static inline const uint8_t* get_varint(const uint8_t* in, const uint8_t* end,
 }
 
 int64_t lzb_max_compressed_size(int64_t n) {
-  // worst case: all literals -> n + n/128 token bytes + header
-  return 16 + n + n / 128 + 8;
+  // True worst case is NOT all-literals (n + n/128): alternating
+  // [4-byte match][1-byte literal run] emits up to 4 + 2 = 6 bytes per
+  // 5 input bytes (control + 3-byte varint distance for the match, then
+  // a token byte + the literal) — 1.2x expansion.  Bound with n + n/4
+  // (1.25x), which dominates every mix of matches (out <= in) and
+  // literal runs (out <= in + runs, runs <= in/5 between matches,
+  // <= in/128 otherwise).  Undersizing this corrupted the heap on real
+  // 12.8 MB activation payloads (r5).
+  return 24 + n + n / 4;
 }
 
 int64_t lzb_compress(const uint8_t* src, int64_t n, uint8_t* dst) {

@@ -223,7 +223,11 @@ def _lzb_compress_py(src: bytes) -> bytes:
             lit_start += take
 
     while i + _LZB_MIN_MATCH <= n:
-        key = src[i:i + 4]
+        # the SAME 16-bit multiplicative hash as the native matcher
+        # (codec.cpp lzb_hash), so both backends pick identical match
+        # candidates — including collisions — and emit identical streams
+        v = int.from_bytes(src[i:i + 4], "little")
+        key = ((v * 2654435761) & 0xFFFFFFFF) >> 16
         cand = head.get(key, -1)
         head[key] = i
         if cand >= 0 and i - cand <= 0xFFFF \
@@ -278,6 +282,13 @@ def _lzb_compress(data: bytes, lib) -> bytes:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     if written < 0:
         raise ValueError("lzb_compress failed")
+    if written > cap:  # the bound is the memory-safety contract: a
+        # breach means the heap is already overrun — fail IMMEDIATELY
+        # and loudly instead of aborting at some later malloc (the r5
+        # 12.8 MB activation-payload failure mode)
+        raise RuntimeError(
+            f"lzb_compress wrote {written} > capacity {cap}: "
+            f"lzb_max_compressed_size bound violated")
     return out[:written].tobytes()
 
 

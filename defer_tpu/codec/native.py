@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
@@ -22,28 +21,19 @@ _lib = None
 _tried = False
 
 
-def _build() -> bool:
-    src = os.path.join(_NATIVE_DIR, "codec.cpp")
-    if not os.path.exists(src):
-        return False
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o",
-             _SO_PATH, src],
-            check=True, capture_output=True, timeout=120)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
-
-
 def load():
-    """The loaded ctypes library, or None if unavailable."""
+    """The loaded ctypes library, or None if unavailable.
+
+    A rebuild-needing (missing OR stale) library that fails to build
+    yields None — the NumPy fallback — never the stale binary."""
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH) and not _build():
+        from ..utils._nativebuild import ensure_built
+        if not ensure_built(os.path.join(_NATIVE_DIR, "codec.cpp"),
+                            _SO_PATH):
             return None
         try:
             lib = ctypes.CDLL(_SO_PATH)

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 import numpy as np
@@ -37,15 +36,10 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        src = os.path.join(_NATIVE_DIR, "staging.cpp")
-        if not os.path.exists(_SO_PATH):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o",
-                     _SO_PATH, src],
-                    check=True, capture_output=True, timeout=120)
-            except (OSError, subprocess.SubprocessError):
-                return None
+        from ..utils._nativebuild import ensure_built
+        if not ensure_built(os.path.join(_NATIVE_DIR, "staging.cpp"),
+                            _SO_PATH):
+            return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:

@@ -1,0 +1,43 @@
+"""Shared on-demand builder for the first-party C++ libraries.
+
+One place owns the three rules both loaders (codec, staging ring) need:
+
+- **staleness**: a ``.so`` older than its ``.cpp`` is rebuilt — a stale
+  binary silently running old code is how the r5 lzb heap-overflow fix
+  could have failed to take effect on machines with a pre-fix build;
+- **no stale fallback**: if a needed rebuild fails, the caller gets
+  ``False`` and must fall back to its NumPy/Python path, NEVER the
+  known-stale binary;
+- **atomic install**: g++ writes a temp path that is ``os.replace``d
+  into place, so concurrent builders (pytest workers, parallel
+  processes) can never leave a half-written library for ``CDLL``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+
+def ensure_built(src: str, so_path: str, timeout: float = 120.0) -> bool:
+    """True iff ``so_path`` exists and is at least as new as ``src``."""
+    if not os.path.exists(src):
+        return os.path.exists(so_path)
+    stale = (os.path.exists(so_path)
+             and os.path.getmtime(src) > os.path.getmtime(so_path))
+    if os.path.exists(so_path) and not stale:
+        return True
+    tmp = f"{so_path}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-o", tmp,
+             src],
+            check=True, capture_output=True, timeout=timeout)
+        os.replace(tmp, so_path)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False

@@ -1,0 +1,108 @@
+"""Throughput/ratio benchmark for the first-party host-edge codecs.
+
+The reference compresses every wire payload with ZFP-then-LZ4
+(reference src/dispatcher.py:81-84); this framework's analogues are the
+native blockfloat ``BFC1`` (lossy float codec) and ``LZB1`` (LZ77 byte
+codec) from ``_native/codec.cpp``, layered as ``PipelineCodec`` the
+same way.  This measures what the reference never did: encode/decode
+MB/s and compression ratio per codec, on realistic payloads: the REAL
+wire payload at a ResNet50 cut point (the pre-activation residual add
+— dense, which is why the float-domain blockfloat, not byte-domain
+LZ77, is the lever there — exactly the regime the reference shipped
+through ZFP) and the post-ReLU activation (sparse, the LZ-favorable
+case).
+
+One JSON line on stdout; CPU-only (the host edge is where these run).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from defer_tpu.codec import (BlockFloatCodec, LosslessCodec,
+                                 PipelineCodec, RawCodec, native_available)
+    from defer_tpu.models import resnet50
+
+    # realistic payload: a mid-network ReLU activation (sparse, smooth
+    # block statistics — what blockfloat/LZ77 actually see in service)
+    g = resnet50()
+    params = g.init(jax.random.key(0))
+    x = np.asarray(jax.random.normal(jax.random.key(1), (2, 224, 224, 3)),
+                   np.float32)
+
+    def run_to(node):
+        vals = {g.input_name: x}
+        for nm in g.topo_order:
+            nd = g.nodes[nm]
+            vals[nm] = nd.op.apply(params.get(nm, {}),
+                                   *(vals[i] for i in nd.inputs))
+            if nm == node:
+                return np.asarray(vals[nm], np.float32)
+    add_out = run_to("add_2")
+    relu_name = next(nm for nm, nd in g.nodes.items()
+                     if "add_2" in nd.inputs
+                     and type(nd.op).__name__ == "Activation")
+    relu_out = run_to(relu_name)
+
+    out = {"metric": "host_codec_throughput",
+           "native_available": native_available(), "payloads": {}}
+    codecs = [RawCodec(), BlockFloatCodec(bits=8), BlockFloatCodec(bits=12),
+              LosslessCodec(),
+              PipelineCodec(bits=12)]  # BFC1-in-LZB1, the ZFP+LZ4 shape
+
+    for pname, payload in (("cut_point_add", add_out),
+                           ("post_relu", relu_out)):
+        nbytes = payload.nbytes
+        rows = {}
+        out["payloads"][pname] = {
+            "shape": list(payload.shape), "mb": round(nbytes / 1e6, 3),
+            "zero_fraction": round(float((payload == 0).mean()), 4),
+            "rows": rows}
+        print(f"--- {pname} ({nbytes / 1e6:.1f} MB, "
+              f"{float((payload == 0).mean()):.0%} zeros)",
+              file=sys.stderr, flush=True)
+        _bench_codecs(codecs, payload, rows)
+    print(json.dumps(out))
+
+
+def _bench_codecs(codecs, payload, rows):
+    nbytes = payload.nbytes
+    for c in codecs:
+        name = c.name + (f"{c.bits}" if hasattr(c, "bits") else "")
+        enc = c.encode(payload)  # warm
+        reps = max(3, int(50e6 // max(nbytes, 1)))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            enc = c.encode(payload)
+        t_enc = (time.perf_counter() - t0) / reps
+        dec = c.decode(enc, payload.shape, payload.dtype)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            dec = c.decode(enc, payload.shape, payload.dtype)
+        t_dec = (time.perf_counter() - t0) / reps
+        err = float(np.max(np.abs(dec.astype(np.float64)
+                                  - payload.astype(np.float64))))
+        scale = float(np.max(np.abs(payload))) or 1.0
+        rows[name] = {
+            "ratio": round(nbytes / len(enc), 3),
+            "encode_mb_s": round(nbytes / 1e6 / t_enc, 1),
+            "decode_mb_s": round(nbytes / 1e6 / t_dec, 1),
+            "max_rel_err": round(err / scale, 6),
+        }
+        print(f"{name:16s} ratio {nbytes / len(enc):6.2f}x  "
+              f"enc {nbytes / 1e6 / t_enc:8.1f} MB/s  "
+              f"dec {nbytes / 1e6 / t_dec:8.1f} MB/s  "
+              f"rel err {err / scale:.2e}", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
