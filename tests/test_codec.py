@@ -179,3 +179,41 @@ def test_lzb_expansion_worst_case_bound():
     for codec, enc in ((native_codec, enc_n), (py_codec, enc_p)):
         dec = codec.decode(enc, payload.shape, payload.dtype)
         np.testing.assert_array_equal(dec, payload)
+
+
+def test_ensure_built_contract(tmp_path):
+    """Shared native builder: builds when missing, rebuilds when the
+    source is newer, refuses to bless a stale .so when the rebuild
+    fails (callers then use their NumPy fallback, never stale code)."""
+    import os
+    import shutil
+    import time
+
+    from defer_tpu.utils._nativebuild import ensure_built
+
+    if shutil.which("g++") is None:
+        pytest.skip("no toolchain")
+    src = tmp_path / "m.cpp"
+    so = tmp_path / "m.so"
+    src.write_text('extern "C" int f() { return 1; }\n')
+    assert ensure_built(str(src), str(so))
+    assert so.exists()
+    first = so.stat().st_mtime_ns
+
+    # fresh so, older-or-equal src: no rebuild
+    assert ensure_built(str(src), str(so))
+    assert so.stat().st_mtime_ns == first
+
+    # newer src: rebuild happens (mtime moves)
+    time.sleep(0.01)
+    src.write_text('extern "C" int f() { return 2; }\n')
+    os.utime(src, ns=(time.time_ns(), time.time_ns()))
+    assert ensure_built(str(src), str(so))
+    assert so.stat().st_mtime_ns > first
+
+    # newer src that fails to compile: False, and no half-written temp
+    time.sleep(0.01)
+    src.write_text("this is not C++\n")
+    os.utime(src, ns=(time.time_ns(), time.time_ns()))
+    assert not ensure_built(str(src), str(so))
+    assert not [p for p in tmp_path.iterdir() if ".build." in p.name]
