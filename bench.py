@@ -548,6 +548,11 @@ def main():
         result["mfu_best"] = max(
             [mfu(pipe_ips) or 0.0, mfu(single_best_ips) or 0.0]
             + [v.get("scan_mfu") or 0.0 for v in sweep.values()])
+    # telemetry registry snapshot (per-pipeline push/stage latency
+    # percentiles, per-hop byte counters) — the bench trajectory's
+    # distribution record, not just the window averages above
+    from defer_tpu.obs import REGISTRY
+    result["metrics_registry"] = REGISTRY.snapshot()
     print(json.dumps(result))
 
 

@@ -177,6 +177,11 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
         "single_device_s": round(base_s, 6),
         "single_device_stepwise_s": round(base_step_s, 6),
         "stage_latency_ms": m["stage_latency_ms"],
+        # latency *distributions* (telemetry PR): per-chunk push and
+        # per-stage percentiles, so BENCH_*.json rows carry p50/p95/p99
+        "push_latency_ms": m.get("push_latency_ms"),
+        "stage_latency_percentiles_ms": m.get(
+            "stage_latency_percentiles_ms"),
         "duty_cycle": m["duty_cycle"],
         "pipeline_efficiency": m["pipeline_efficiency"],
         "bubble_fraction": m["bubble_fraction"],

@@ -47,6 +47,8 @@ from .runtime.training import PipelineTrainer
 from .utils.checkpoint import load_params, save_params
 from .utils.export import export_pipeline, export_stage, load_stage
 from .utils.config import DeferConfig
+from .obs import (LatencyHistogram, MetricsRegistry, REGISTRY,
+                  enable_tracing, export_chrome_trace, get_registry, tracer)
 from .utils.metrics import PipelineMetrics, StopwatchWindow
 from .utils.profiling import profile_pipeline, trace
 
@@ -73,4 +75,6 @@ __all__ = [
     "Codec", "BlockFloatCodec", "LosslessCodec", "PipelineCodec", "RawCodec",
     "save_params", "load_params", "profile_pipeline", "trace",
     "export_stage", "export_pipeline", "load_stage",
+    "LatencyHistogram", "MetricsRegistry", "REGISTRY", "get_registry",
+    "tracer", "enable_tracing", "export_chrome_trace",
 ]

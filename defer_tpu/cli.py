@@ -33,6 +33,41 @@ def _get_model(name: str):
     return getattr(models, name)()
 
 
+# -- telemetry plumbing (docs/OBSERVABILITY.md) ----------------------------
+
+def _add_obs_flags(p):
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write spans as Chrome trace-event JSON "
+                        "(open at https://ui.perfetto.dev)")
+    p.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write a JSON snapshot of the metrics registry "
+                        "(counters, byte counts, latency percentiles)")
+
+
+def _obs_begin(args):
+    """Enable the process tracer when a trace export was requested."""
+    if getattr(args, "trace_out", None):
+        from .obs import enable_tracing
+        enable_tracing(process="dispatcher").start_trace()
+
+
+def _obs_finish(args, extra: dict | None = None):
+    """Write the requested telemetry artifacts (no-op without flags)."""
+    if getattr(args, "trace_out", None):
+        from .obs import export_chrome_trace
+        export_chrome_trace(args.trace_out)
+        print(f"trace -> {args.trace_out}", file=sys.stderr)
+    if getattr(args, "metrics_out", None):
+        from .obs import REGISTRY
+        snap = {"registry": REGISTRY.snapshot()}
+        if extra:
+            snap.update(extra)
+        with open(args.metrics_out, "w") as f:
+            json.dump(snap, f, indent=2, default=str)
+            f.write("\n")
+        print(f"metrics -> {args.metrics_out}", file=sys.stderr)
+
+
 def cmd_models(_args):
     from . import models
     for n in models.__all__:
@@ -98,9 +133,13 @@ def cmd_bench(args):
 
     from . import SpmdPipeline, partition, pipeline_mesh
 
+    _obs_begin(args)
     graph = _get_model(args.model)
     params = graph.init(jax.random.key(0))
     cuts = args.cuts.split(",") if args.cuts else None
+    if cuts is None and args.stages is None:
+        # default deployment: one stage per device
+        args.stages = len(jax.devices())
     stages = partition(graph, cuts, num_stages=args.stages)
     n = len(stages)
     pipe = SpmdPipeline(
@@ -116,20 +155,33 @@ def cmd_bench(args):
         pipe.push(xs, n_real=args.chunk)
         jax.block_until_ready(pipe._a)
 
+    from .obs import tracer as _tracer
+
     step()  # compile
-    t0 = time.perf_counter()
-    iters = 0
-    while time.perf_counter() - t0 < args.seconds:
-        step()
-        iters += 1
-    dt = time.perf_counter() - t0
+    # the compile push must not pollute the exported steady-state
+    # percentiles (it is seconds; the window pushes are milliseconds)
+    pipe.metrics.clear_counters()
+    with _tracer().span("dispatcher.bench_window",
+                        {"model": args.model, "chunk": args.chunk,
+                         "microbatch": args.microbatch}):
+        t0 = time.perf_counter()
+        iters = 0
+        while time.perf_counter() - t0 < args.seconds:
+            step()
+            iters += 1
+        dt = time.perf_counter() - t0
     ips = iters * args.chunk * args.microbatch / dt
+    if args.trace_out or args.metrics_out:
+        # per-stage spans + latency histograms for the exports (times the
+        # deployed branches; not part of the throughput window above)
+        pipe.stage_latencies(iters=3)
     print(json.dumps({
         "metric": f"{args.model}_{n}stage_throughput",
         "value": round(ips, 3), "unit": "inferences/sec",
         "wire": args.wire,
         "devices": len(jax.devices()),
         **pipe.metrics.as_dict()}))
+    _obs_finish(args, {"pipeline": pipe.metrics.as_dict()})
 
 
 def cmd_export(args):
@@ -167,6 +219,7 @@ def cmd_chain(args):
     from . import partition
     from .runtime.node import run_chain
 
+    _obs_begin(args)
     graph = _get_model(args.model)
     params = graph.init(jax.random.key(0))
     cuts = args.cuts.split(",") if args.cuts else None
@@ -191,6 +244,7 @@ def cmd_chain(args):
         "stages": len(stages), "codec": args.codec,
         "max_abs_err_vs_single_program": worst,
     }))
+    _obs_finish(args)
 
 
 def cmd_train(args):
@@ -269,9 +323,17 @@ def cmd_generate(args):
     # different configuration than the JSON record claims
     kw = dict(token_chunk=args.token_chunk, temperature=args.temperature,
               top_k=args.top_k, seed=args.seed, prefill=args.prefill)
+    from .obs import REGISTRY, tracer
     dec.generate(prompt, args.new_tokens, **kw)   # compile
+    # steady-state exports only: drop the compile run's decode samples
+    # and enable tracing for the warm run
+    REGISTRY.histogram("decode.dispatch_s").clear()
+    REGISTRY.counter("decode.dispatches").n = 0
+    _obs_begin(args)
     t0 = time.perf_counter()
-    toks = dec.generate(prompt, args.new_tokens, **kw)   # warm
+    with tracer().span("generate", {"model": args.model,
+                                    "new_tokens": args.new_tokens}):
+        toks = dec.generate(prompt, args.new_tokens, **kw)   # warm
     dt = time.perf_counter() - t0
     print(json.dumps({
         "model": args.model, "stages": args.stages,
@@ -282,6 +344,7 @@ def cmd_generate(args):
         "tokens_per_s": round(b * args.new_tokens / dt, 2),
         "first_row": toks[0].tolist(),
     }))
+    _obs_finish(args)
 
 
 def main(argv=None):
@@ -311,6 +374,7 @@ def main(argv=None):
     b.add_argument("--microbatch", type=int, default=1)
     b.add_argument("--wire", default="buffer", choices=["buffer", "int8"])
     b.add_argument("--seconds", type=float, default=5.0)
+    _add_obs_flags(b)
 
     e = sub.add_parser("export", help="write per-stage StableHLO artifacts")
     e.add_argument("--model", required=True)
@@ -343,6 +407,7 @@ def main(argv=None):
     c.add_argument("--in-band", action="store_true",
                    help="boot nodes empty; ship artifacts over the "
                         "control handshake")
+    _add_obs_flags(c)
 
     t = sub.add_parser("train", help="pipeline-parallel training demo "
                                      "(synthetic data, cross-entropy)")
@@ -379,6 +444,7 @@ def main(argv=None):
                         "(channel-wise scales, dequant fused per stage)")
     g.add_argument("--beam", type=int, default=1,
                    help="beam width (must divide --microbatch)")
+    _add_obs_flags(g)
 
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition,

@@ -1,0 +1,234 @@
+"""Process-wide metrics registry: named counters, gauges, histograms.
+
+One registry per process (module-level :data:`REGISTRY`); instruments are
+created once by name and then held by the instrumented code as plain
+attributes — the hot path never goes through the registry dict.  Snapshots
+are pull-based: ``snapshot()`` returns a JSON-ready dict, ``exposition()``
+a Prometheus-style text page (counters/gauges as-is, histograms as
+summaries with p50/p95/p99 quantile lines).
+
+Callbacks let existing stat objects (e.g. ``PipelineMetrics``'s plain-int
+counters) appear in snapshots without paying any registry cost when they
+update: the registry calls them at snapshot time only.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Callable
+
+from .histogram import LatencyHistogram
+
+
+class Counter:
+    """Monotonic counter.  ``n`` is a plain int — increment it directly
+    on hot paths (``c.n += k``); ``inc`` is the readable spelling."""
+
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, k: int = 1) -> None:
+        self.n += k
+
+    @property
+    def value(self) -> int:
+        return self.n
+
+    def __repr__(self):
+        return f"Counter({self.n})"
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0.0
+
+    def set(self, v: float) -> None:
+        self.v = v
+
+    @property
+    def value(self) -> float:
+        return self.v
+
+    def __repr__(self):
+        return f"Gauge({self.v})"
+
+
+def _prom_name(name: str) -> str:
+    """Dotted metric name -> Prometheus-legal name."""
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+class MetricsRegistry:
+    """Named instruments with get-or-create semantics.
+
+    Creation is locked (threads race to register the same name and must
+    get the same object); reads/increments touch the instrument directly.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+        self._callbacks: dict[str, Callable[[], object]] = {}
+
+    # -- creation ----------------------------------------------------------
+
+    def _get_or_create(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} is {type(m).__name__}, "
+                    f"not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        return self._get_or_create(name, LatencyHistogram)
+
+    def register(self, name: str, instrument, weak: bool = False) -> None:
+        """Attach an externally-owned instrument under ``name`` (e.g. a
+        histogram living inside ``PipelineMetrics``).  Re-registering the
+        same name replaces the entry — deployments are rebuilt in place.
+        ``weak=True`` holds the instrument by weakref: once its owner is
+        collected the entry is pruned at the next snapshot, so transient
+        deployments don't grow the registry forever."""
+        import weakref
+        with self._lock:
+            self._metrics[name] = weakref.ref(instrument) if weak \
+                else instrument
+
+    def register_callback(self, name: str,
+                          fn: Callable[[], object]) -> None:
+        """``fn()`` is evaluated at snapshot time; zero steady-state cost."""
+        with self._lock:
+            self._callbacks[name] = fn
+
+    def unregister(self, prefix: str) -> None:
+        """Drop every instrument/callback whose name starts with ``prefix``."""
+        with self._lock:
+            for d in (self._metrics, self._callbacks):
+                for k in [k for k in d if k.startswith(prefix)]:
+                    del d[k]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._callbacks.clear()
+
+    # -- export ------------------------------------------------------------
+
+    def _live_metrics(self) -> dict:
+        """Snapshot of the instrument dict with weakrefs resolved; dead
+        weak entries are pruned in place (their owner was collected)."""
+        import weakref
+        with self._lock:
+            out = {}
+            dead = []
+            for name, m in self._metrics.items():
+                if isinstance(m, weakref.ref):
+                    m = m()
+                    if m is None:
+                        dead.append(name)
+                        continue
+                out[name] = m
+            for name in dead:
+                del self._metrics[name]
+            return out
+
+    def snapshot(self) -> dict:
+        """JSON-ready view: counters/gauges as numbers, histograms as
+        {count, sum, mean, min, p50, p95, p99, max} summaries.
+
+        Expiry contract: a callback returning ``None`` marks itself
+        expired (its source was collected) and is pruned, as are dead
+        weak-registered instruments — so transient deployments do not
+        accumulate in the registry forever."""
+        metrics = self._live_metrics()
+        with self._lock:
+            callbacks = dict(self._callbacks)
+        out: dict = {}
+        for name, m in sorted(metrics.items()):
+            if isinstance(m, LatencyHistogram):
+                out[name] = m.summary()
+            elif isinstance(m, (Counter, Gauge)):
+                out[name] = m.value
+            else:  # foreign instrument: best effort
+                out[name] = getattr(m, "value", repr(m))
+        expired = []
+        for name, fn in sorted(callbacks.items()):
+            try:
+                v = fn()
+            except Exception as e:  # noqa: BLE001 — a dead callback must
+                out[name] = f"<callback error: {e!r}>"  # not kill export
+                continue
+            if v is not None:
+                out[name] = v
+            else:
+                expired.append(name)
+        if expired:
+            with self._lock:
+                for name in expired:
+                    self._callbacks.pop(name, None)
+        return out
+
+    def exposition(self) -> str:
+        """Prometheus text format (histograms as summaries)."""
+        metrics = self._live_metrics()
+        with self._lock:
+            callbacks = dict(self._callbacks)
+        lines: list[str] = []
+        for name, m in sorted(metrics.items()):
+            pn = _prom_name(name)
+            if isinstance(m, LatencyHistogram):
+                lines.append(f"# TYPE {pn} summary")
+                for q in (0.5, 0.95, 0.99):
+                    lines.append(
+                        f'{pn}{{quantile="{q}"}} {m.quantile(q):.9g}')
+                lines.append(f"{pn}_sum {m.sum:.9g}")
+                lines.append(f"{pn}_count {m.count}")
+            elif isinstance(m, Counter):
+                lines.append(f"# TYPE {pn} counter")
+                lines.append(f"{pn} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pn} gauge")
+                lines.append(f"{pn} {m.value:.9g}")
+        for name, fn in sorted(callbacks.items()):
+            try:
+                v = fn()
+            except Exception:  # noqa: BLE001 — skip dead callbacks
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                pn = _prom_name(name)
+                lines.append(f"# TYPE {pn} gauge")
+                lines.append(f"{pn} {v:.9g}" if isinstance(v, float)
+                             else f"{pn} {v}")
+        return "\n".join(lines) + "\n"
+
+    def dump_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=2, default=str)
+            f.write("\n")
+
+
+#: the process-wide registry every subsystem instruments into
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY

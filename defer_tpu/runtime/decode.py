@@ -47,6 +47,7 @@ caches, dropping prompt cost from ``plen * N`` ring steps to ``2N - 1``.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
@@ -58,7 +59,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graph.ir import LayerGraph
 from ..models.gpt import CausalTransformerBlock, GptEmbedding
+from ..obs import REGISTRY, tracer
 from ..parallel.mesh import STAGE_AXIS, pipeline_mesh
+from ..utils.compat import shard_map
 from ..utils.xla_opts import ring_jit_kwargs
 from . import flatbuf
 
@@ -567,7 +570,7 @@ class PipelinedDecoder:
             return jax.tree.map(lambda c: c[None], local), ids[None]
 
         state = self._state_specs()
-        fn = jax.shard_map(
+        fn = shard_map(
             device_prefill, mesh=self.mesh,
             in_specs=(self._wspec_tree, P(None, None, None), P(), P(),
                       state),
@@ -654,7 +657,7 @@ class PipelinedDecoder:
         state = self._state_specs()
         out_ids = P(STAGE_AXIS, None, None, None) if beam \
             else P(STAGE_AXIS, None, None)
-        fn = jax.shard_map(
+        fn = shard_map(
             device_decode, mesh=self.mesh,
             in_specs=(self._wspec_tree, P(None, None, None), P(), P(),
                       P(), P(), P(), P(None, None), P(), P(),
@@ -861,11 +864,22 @@ class PipelinedDecoder:
                       rows=(0, b))
             p_done = plen
         steps_run = 0
+        dec_count = REGISTRY.counter("decode.dispatches")
+        dec_hist = REGISTRY.histogram("decode.dispatch_s")
+        tr = tracer()
         while steps_run < num_steps:
+            t0_disp = time.perf_counter()
             a, caches, ids = fn(self._w, prompt_dev, plen_s,
                                 jnp.int32(steps_run), jnp.int32(num_steps),
                                 seed_s, temp_s, fi_dev, fp_s, start_s,
                                 a, caches)
+            dt_disp = time.perf_counter() - t0_disp
+            dec_count.n += 1
+            dec_hist.record(dt_disp)
+            if tr.enabled:
+                tr.record("decode.chunk", t0_disp, dt_disp,
+                          {"steps_run": steps_run,
+                           "chunk_steps": chunk_steps})
             if incremental:
                 # incremental scatter of just this chunk: linear host work
                 self._gather_into(out3, np.asarray(ids[0]), steps_run,

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..graph.ir import LayerGraph
+from ..obs import REGISTRY, tracer
 from ..parallel.mesh import pipeline_mesh
 from ..partition.partitioner import partition
 from ..utils.config import DeferConfig
@@ -201,8 +202,10 @@ class Defer:
             if len(self._decoder_cache) >= self._CACHE_MAX:
                 self._decoder_cache.pop(next(iter(self._decoder_cache)))
             self._decoder_cache[key] = (graph, params, dec)
-        return dec.generate(np.asarray(prompt_ids), max_new_tokens,
-                            **sample_kw)
+        with tracer().span("defer.generate",
+                           {"new_tokens": max_new_tokens}):
+            return dec.generate(np.asarray(prompt_ids), max_new_tokens,
+                                **sample_kw)
 
     def logits(self, graph, params, ids, *, cut_points=None,
                num_stages: int | None = None) -> np.ndarray:
@@ -384,6 +387,8 @@ class Defer:
         ring = HostStagingRing(mb * buf, n_slots=n_slots)
         srv = _socket.create_server((host, port))
         address = srv.getsockname()
+        ep_in = REGISTRY.counter("endpoint.samples_in")
+        ep_out = REGISTRY.counter("endpoint.samples_out")
 
         #: endpoint-fatal errors (pipeline death) PLUS per-client aborts;
         #: a client whose stream errors is cut WITHOUT the END frame so it
@@ -483,6 +488,7 @@ class Defer:
                                 with client.state:
                                     client.outstanding -= 1
                         if ok:
+                            ep_in.n += 1
                             break
                         if time.monotonic() > deadline:
                             raise RuntimeError(
@@ -520,6 +526,7 @@ class Defer:
                     errors.append(e)
                     _finish(client, send_eos=False)
                 else:
+                    ep_out.n += 1
                     _maybe_drained(client)
 
         def serve():
@@ -604,6 +611,8 @@ class Defer:
         pipe = self.build(graph, params, cut_points, num_stages)
         stop = threading.Event()
         cfg = self.config
+        disp_count = REGISTRY.counter("dispatcher.dispatches")
+        disp_hist = REGISTRY.histogram("dispatcher.dispatch_s")
 
         def _dispatch(gen, fn, *a, arm=True, **kw):
             # bracket device work so the watchdog can tell "waiting for
@@ -614,6 +623,7 @@ class Defer:
             # generation-guarded: a wedged thread that unwedges after a
             # recovery must not clobber the live generation's markers.
             t0 = time.monotonic()
+            tp0 = time.perf_counter()
             if arm and handle._gen == gen:
                 handle._busy_since = t0
             try:
@@ -625,6 +635,12 @@ class Defer:
                 handle._dispatches += 1
                 handle._max_dispatch_s = max(handle._max_dispatch_s,
                                              time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            disp_count.n += 1
+            disp_hist.record(dt)
+            tr = tracer()
+            if tr.enabled:
+                tr.record("dispatcher.dispatch", tp0, dt, {"gen": gen})
             return out
 
         def _serve_inner(pipe, replay, gen):

@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import tracer
 from ..partition.stage import StageSpec
 from ..utils.metrics import PipelineMetrics
 
@@ -59,7 +60,8 @@ class MpmdPipeline:
         ]
         self.in_spec = self.stages[0].in_spec
         self.out_spec = self.stages[-1].out_spec
-        self.metrics = PipelineMetrics(num_stages=n)
+        self.metrics = PipelineMetrics(num_stages=n, microbatch=microbatch)
+        self.metrics.bind()
         self.reset()
 
     # ------------------------------------------------------------------
@@ -112,7 +114,13 @@ class MpmdPipeline:
             jax.block_until_ready(emitted)
         self.metrics.steps += c
         self.metrics.chunk_calls += 1
-        self.metrics.wall_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.metrics.wall_s += dt
+        self.metrics.push_latency.record(dt)
+        tr = tracer()
+        if tr.enabled:
+            tr.record("mpmd.push", t0, dt,
+                      {"chunk": c, "n_real": n_real})
         return emitted
 
     def flush(self):

@@ -44,8 +44,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graph.ir import ShapeSpec
+from ..obs import tracer
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS, pipeline_mesh
 from ..partition.stage import StageSpec, buffer_footprint
+from ..utils.compat import shard_map
 from ..utils.metrics import PipelineMetrics
 from ..utils.xla_opts import ring_jit_kwargs
 from . import flatbuf
@@ -154,6 +156,10 @@ class SpmdPipeline:
         self.metrics = PipelineMetrics(
             num_stages=n, microbatch=microbatch, buffer_elems=self.buf_elems,
             buffer_bytes_per_hop=self._footprint["bytes_per_hop"])
+        # telemetry: publish this deployment into the process registry
+        # (scalar counters + push/stage histograms + derived per-hop
+        # bytes-on-wire — the ICI-side wire accounting)
+        self.metrics.bind()
         self._flush_zeros = None  # lazy device-resident bubble block
         self.reset()
 
@@ -332,7 +338,7 @@ class SpmdPipeline:
         ospec = P(STAGE_AXIS, None, DATA_AXIS, None) if has_dp \
             else P(STAGE_AXIS, None, None, None)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             device_chunk, mesh=self.mesh,
             in_specs=(self._wspec, bspec, xspec),
             out_specs=(bspec, ospec),
@@ -424,7 +430,12 @@ class SpmdPipeline:
         self._fed += c
 
         ready = self._collect(outs, c, raw=raw)
-        self.metrics.wall_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.metrics.wall_s += dt
+        self.metrics.push_latency.record(dt)
+        tr = tracer()
+        if tr.enabled:
+            tr.record("spmd.push", t0, dt, {"chunk": c, "n_real": n_real})
         return ready
 
     def _collect(self, outs, c: int, raw: bool = False):
@@ -572,7 +583,7 @@ class SpmdPipeline:
             if tp_mesh is not None:
                 w_k = jax.device_put(
                     self._w[k], NamedSharding(tp_mesh, P(MODEL_AXIS, None)))
-                fn = jax.jit(jax.shard_map(
+                fn = jax.jit(shard_map(
                     lambda w, a: branch(w[0], a), mesh=tp_mesh,
                     in_specs=(P(MODEL_AXIS, None), P(None, None)),
                     out_specs=P(None, None), check_vma=False))
@@ -584,6 +595,14 @@ class SpmdPipeline:
             for _ in range(iters):
                 y = fn(w_k, a)
             y.block_until_ready()
-            lats.append((time.perf_counter() - t0) / iters)
+            lat = (time.perf_counter() - t0) / iters
+            lats.append(lat)
+            self.metrics.record_stage_latency(k, lat)
+            tr = tracer()
+            if tr.enabled:
+                tr.record(f"stage{k}:{self.stages[k].name}", t0,
+                          time.perf_counter() - t0,
+                          {"stage": k, "mean_latency_s": lat,
+                           "iters": iters})
         self.metrics.stage_latency_s = lats
         return lats

@@ -22,11 +22,14 @@ from __future__ import annotations
 
 import socket
 import struct
+import threading
+import time
 from typing import Any
 
 import numpy as np
 
 from ..codec import BlockFloatCodec, Codec, LosslessCodec, PipelineCodec, RawCodec
+from ..obs import REGISTRY
 
 #: frame kinds
 K_TENSOR = 1
@@ -36,19 +39,39 @@ K_CTRL = 4   # JSON control message (deploy/reweight handshake)
 K_ACK = 5    # the reference's 1-byte \x06 ACK (src/node.py:42), framed
 
 _CODECS: dict[str, Codec] = {}
+#: creation lock: ``TensorClient.infer_stream`` decodes on a receiver
+#: thread while the sender encodes — both may fault the same codec in.
+#: Reads stay lock-free (dict get under the GIL); only misses lock.
+_CODECS_LOCK = threading.Lock()
+
+# wire telemetry: per-hop frame/byte counters plus codec encode/decode
+# latency histograms, all in the process registry.  Plain attribute
+# increments on the hot path; a snapshot is only paid when exported.
+_TX_FRAMES = REGISTRY.counter("transport.tx_frames")
+_TX_BYTES = REGISTRY.counter("transport.tx_bytes")
+_RX_FRAMES = REGISTRY.counter("transport.rx_frames")
+_RX_BYTES = REGISTRY.counter("transport.rx_bytes")
+_ENC_HIST = REGISTRY.histogram("codec.encode_s")
+_DEC_HIST = REGISTRY.histogram("codec.decode_s")
 
 
 def _codec(name: str) -> Codec:
-    if name not in _CODECS:
-        if name == "raw":
-            _CODECS[name] = RawCodec()
-        elif name == "lzb":
-            _CODECS[name] = LosslessCodec()
-        elif name.startswith("bf"):
-            _CODECS[name] = PipelineCodec(bits=int(name[2:]))
-        else:
-            raise ValueError(f"unknown codec {name!r}")
-    return _CODECS[name]
+    c = _CODECS.get(name)
+    if c is not None:
+        return c
+    with _CODECS_LOCK:
+        c = _CODECS.get(name)
+        if c is None:
+            if name == "raw":
+                c = RawCodec()
+            elif name == "lzb":
+                c = LosslessCodec()
+            elif name.startswith("bf"):
+                c = PipelineCodec(bits=int(name[2:]))
+            else:
+                raise ValueError(f"unknown codec {name!r}")
+            _CODECS[name] = c
+    return c
 
 
 # header: kind u8 | codec len u8 | dtype len u8 | ndim u8 | payload len u64
@@ -66,7 +89,9 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
     else:
         arr = np.asarray(arr_or_bytes)
         kind = K_TENSOR
+        t0 = time.perf_counter()
         payload = _codec(codec).encode(arr)
+        _ENC_HIST.record(time.perf_counter() - t0)
         cname = codec.encode()
         dt = arr.dtype.str.encode()
         meta = dt + b"".join(struct.pack(">Q", s) for s in arr.shape)
@@ -74,6 +99,8 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
     dt_len = len(meta) - 8 * ndim if kind == K_TENSOR else 0
     hdr = _HDR.pack(kind, len(cname), dt_len, ndim, len(payload))
     sock.sendall(hdr + cname + meta + payload)
+    _TX_FRAMES.n += 1
+    _TX_BYTES.n += _HDR.size + len(cname) + len(meta) + len(payload)
 
 
 def send_end(sock: socket.socket):
@@ -117,6 +144,8 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     """Receive one frame -> (kind, payload).  Tensor frames are decoded to
     ndarrays; K_END returns (K_END, None)."""
     kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    _RX_FRAMES.n += 1
+    _RX_BYTES.n += _HDR.size + clen + dlen + 8 * ndim + plen
     if kind == K_END:
         return K_END, None
     if kind == K_ACK:
@@ -133,7 +162,10 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     shape = tuple(struct.unpack(">Q", _recv_exact(sock, 8))[0]
                   for _ in range(ndim))
     payload = _recv_exact(sock, plen)
-    return K_TENSOR, _codec(cname).decode(payload, shape, dt)
+    t0 = time.perf_counter()
+    value = _codec(cname).decode(payload, shape, dt)
+    _DEC_HIST.record(time.perf_counter() - t0)
+    return K_TENSOR, value
 
 
 class TensorServer:
@@ -167,10 +199,15 @@ class TensorServer:
 
 
 class TensorClient:
-    """Client side: request/reply ``infer`` or full-duplex ``infer_stream``."""
+    """Client side: request/reply ``infer`` or full-duplex ``infer_stream``.
 
-    def __init__(self, host: str, port: int):
+    ``timeout_s`` bounds how long ``infer_stream`` waits for the endpoint
+    to drain after the last input (per-call override available); the old
+    hardcoded 600 s default is kept for compatibility."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 600.0):
         self._sock = socket.create_connection((host, port))
+        self.timeout_s = timeout_s
 
     def infer(self, arr: np.ndarray, *, codec: str = "raw") -> np.ndarray:
         send_frame(self._sock, arr, codec=codec)
@@ -179,13 +216,18 @@ class TensorClient:
             raise ConnectionError("expected tensor reply")
         return value
 
-    def infer_stream(self, arrays, *, codec: str = "raw") -> list:
+    def infer_stream(self, arrays, *, codec: str = "raw",
+                     timeout_s: float | None = None) -> list:
         """Pipelined streaming against a ``Defer.serve_endpoint``: sends
         every input without waiting (keeping the remote pipeline full),
         collects in-order replies concurrently, ends the stream, and
         returns all results.  One call = the reference harness's whole
-        send-loop + result-server pair (reference test/test.py:39-51)."""
-        import threading
+        send-loop + result-server pair (reference test/test.py:39-51).
+
+        ``timeout_s`` bounds the post-END drain wait (default: the
+        client's ``timeout_s``)."""
+        if timeout_s is None:
+            timeout_s = self.timeout_s
 
         results: list[np.ndarray] = []
         err: list[BaseException] = []
@@ -205,11 +247,12 @@ class TensorClient:
         for a in arrays:
             send_frame(self._sock, a, codec=codec)
         send_end(self._sock)
-        t.join(timeout=600)
+        t.join(timeout=timeout_s)
         if err:
             raise err[0]
         if t.is_alive():
-            raise TimeoutError("endpoint did not drain within timeout")
+            raise TimeoutError(
+                f"endpoint did not drain within {timeout_s:.0f}s")
         return results
 
     def close(self):

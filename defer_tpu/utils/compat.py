@@ -1,0 +1,52 @@
+"""JAX version compatibility shims.
+
+The engines target the current ``jax.shard_map(..., check_vma=...)`` API;
+older installs (<= 0.4.x) only have ``jax.experimental.shard_map`` whose
+replication-check kwarg is spelled ``check_rep``.  Every shard_map call in
+the codebase goes through this one wrapper so the version probe happens
+once, at import.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_impl = getattr(jax, "shard_map", None)
+_LEGACY = _impl is None
+if _LEGACY:
+    from jax.experimental.shard_map import shard_map as _impl  # type: ignore
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions (``check_vma``/``check_rep``).
+
+    On the legacy API the replication checker is always disabled: the old
+    ``check_rep`` implementation false-positives on valid programs (e.g.
+    ``lax.cond`` branches — jax's own error suggests ``check_rep=False``
+    as the workaround), and it is purely a debugging aid.  The modern
+    ``check_vma`` checker honours the caller's flag."""
+    if _LEGACY:
+        return _impl(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+    return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                 check_vma=check_vma)
+
+
+def pcast_varying(x, axes):
+    """``lax.pcast(..., to="varying")`` where the VMA type system exists;
+    identity on legacy jax (no varying-manual-axes typing to satisfy)."""
+    pc = getattr(jax.lax, "pcast", None)
+    if pc is None:
+        return x
+    return pc(x, axes, to="varying")
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis (``lax.axis_size`` on current
+    jax; the ``core.axis_frame`` lookup on legacy versions, where the
+    frame resolves directly to the int size)."""
+    ax = getattr(jax.lax, "axis_size", None)
+    if ax is not None:
+        return ax(axis_name)
+    from jax import core
+    return core.axis_frame(axis_name)

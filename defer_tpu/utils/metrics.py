@@ -1,11 +1,26 @@
 """Pipeline metrics — first-class per BASELINE.md (inferences/sec and
 per-stage latency).  The reference only counts results in a timed window in
-its harness (test/test.py:29-37); here the runtime itself records stats."""
+its harness (test/test.py:29-37); here the runtime itself records stats.
+
+Since the telemetry PR, the averages are backed by ``defer_tpu.obs``:
+per-chunk push latency and per-stage latency are log-bucketed histograms
+(p50/p95/p99/max), and :meth:`PipelineMetrics.bind` publishes every field
+into the process-wide :data:`~defer_tpu.obs.REGISTRY` so one snapshot
+carries the whole deployment.  The streaming counters stay plain ints —
+the hot path pays attribute increments, never a registry lookup.
+"""
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
+import weakref
+
+from ..obs import REGISTRY, LatencyHistogram
+
+#: unique registry prefixes for successive deployments in one process
+_PIPE_SEQ = itertools.count()
 
 
 @dataclasses.dataclass
@@ -19,6 +34,15 @@ class PipelineMetrics:
     stage_latency_s: list[float] = dataclasses.field(default_factory=list)
     buffer_elems: int = 0
     buffer_bytes_per_hop: int = 0
+    #: per-chunk ``push`` wall time (host dispatch + collect), log-bucketed
+    push_latency: LatencyHistogram = dataclasses.field(
+        default_factory=LatencyHistogram)
+    #: per-stage compiled-branch latency distributions (filled by
+    #: ``record_stage_latency`` / ``SpmdPipeline.stage_latencies``)
+    stage_hists: list[LatencyHistogram] = dataclasses.field(
+        default_factory=list)
+    #: registry prefix once bound (``bind``), e.g. "pipeline3"
+    prefix: str | None = None
 
     def clear_counters(self):
         """Zero the streaming counters (keep stage latencies / geometry) —
@@ -27,6 +51,70 @@ class PipelineMetrics:
         self.steps = 0
         self.wall_s = 0.0
         self.chunk_calls = 0
+        self.push_latency.clear()
+
+    # -- registry view -----------------------------------------------------
+
+    def bind(self, registry=None, prefix: str | None = None) -> str:
+        """Publish this deployment's metrics into ``registry`` (default:
+        the process-wide one) under ``prefix`` (default: a fresh
+        ``pipeline<N>``).  Counters are exported via snapshot-time
+        callbacks, so updating them stays a plain int increment; the
+        histograms are registered as live instruments.  Returns the
+        prefix.  Idempotent per instance."""
+        if self.prefix is not None:
+            return self.prefix
+        registry = registry or REGISTRY
+        self._registry = registry
+        self.prefix = prefix or f"pipeline{next(_PIPE_SEQ)}"
+        p = self.prefix
+        # weakref callbacks: the registry must not keep dead deployments'
+        # metrics alive (Defer.build makes a fresh pipeline per call);
+        # once the deployment is collected its callbacks return None and
+        # the snapshot drops them.  The histograms are registered as live
+        # instruments — small, and useful post-mortem.
+        ref = weakref.ref(self)
+        for field in ("num_stages", "microbatch", "inferences", "steps",
+                      "wall_s", "chunk_calls", "buffer_bytes_per_hop"):
+            registry.register_callback(
+                f"{p}.{field}",
+                lambda r=ref, f=field:
+                    getattr(r(), f) if r() is not None else None)
+        registry.register_callback(
+            f"{p}.throughput_per_s",
+            lambda r=ref:
+                round(r().throughput, 3) if r() is not None else None)
+        # per-hop bytes-on-wire: every ppermute hop of the homogeneous
+        # buffer carries bytes_per_hop per step, so the counters are
+        # derived at snapshot time — zero cost on the push hot path
+        if self.buffer_bytes_per_hop and self.num_stages:
+            for k in range(self.num_stages):
+                registry.register_callback(
+                    f"{p}.hop{k}.bytes",
+                    lambda r=ref: r().steps * r().buffer_bytes_per_hop
+                    if r() is not None else None)
+        # weak: the histogram lives (and dies) with this deployment; the
+        # registry prunes the entry once the deployment is collected
+        registry.register(f"{p}.push_latency_s", self.push_latency,
+                          weak=True)
+        return p
+
+    def record_stage_latency(self, stage: int, seconds: float) -> None:
+        """Feed one per-stage latency sample (grows the histogram list on
+        demand and keeps the legacy ``stage_latency_s`` means in sync)."""
+        while len(self.stage_hists) <= stage:
+            self.stage_hists.append(LatencyHistogram())
+            if self.prefix is not None:
+                getattr(self, "_registry", REGISTRY).register(
+                    f"{self.prefix}.stage{len(self.stage_hists) - 1}"
+                    f".latency_s", self.stage_hists[-1], weak=True)
+        h = self.stage_hists[stage]
+        h.record(seconds)
+        while len(self.stage_latency_s) <= stage:
+            self.stage_latency_s.append(0.0)
+        self.stage_latency_s[stage] = h.mean
+
+    # -- derived views -----------------------------------------------------
 
     @property
     def throughput(self) -> float:
@@ -64,9 +152,11 @@ class PipelineMetrics:
         return sum(d) / len(d) if d else 0.0
 
     def as_dict(self) -> dict:
-        return {
+        d = {
             "num_stages": self.num_stages,
+            "microbatch": self.microbatch,
             "inferences": self.inferences,
+            "steps": self.steps,
             "wall_s": round(self.wall_s, 6),
             "throughput_per_s": round(self.throughput, 3),
             "chunk_calls": self.chunk_calls,
@@ -76,6 +166,13 @@ class PipelineMetrics:
             "duty_cycle": [round(d, 4) for d in self.duty_cycle],
             "pipeline_efficiency": round(self.pipeline_efficiency, 4),
         }
+        if self.push_latency.count:
+            d["push_latency_ms"] = self.push_latency.summary(scale=1e3,
+                                                             ndigits=4)
+        if any(h.count for h in self.stage_hists):
+            d["stage_latency_percentiles_ms"] = [
+                h.summary(scale=1e3, ndigits=4) for h in self.stage_hists]
+        return d
 
 
 class StopwatchWindow:

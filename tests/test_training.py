@@ -30,7 +30,8 @@ def _loss(logits, labels):
                                          axis=-1))
 
 
-@pytest.mark.parametrize("num_stages", [2, 4])
+@pytest.mark.parametrize("num_stages", [
+    pytest.param(2, marks=pytest.mark.slow), 4])
 def test_pipeline_grads_match_single_program(tiny, num_stages):
     g, params = tiny
     stages = partition(g, num_stages=num_stages)
@@ -67,6 +68,7 @@ def test_pipeline_grads_match_single_program(tiny, num_stages):
                                        rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_accumulate_step_equals_one_big_chunk(tiny):
     """Gradient accumulation: K chunks then one update == the single-chunk
     update on the concatenated batch (same loss_fn sums per microbatch)."""
@@ -132,6 +134,7 @@ def test_trained_weights_serve_inference(tiny):
     assert np.isfinite(out).all()
 
 
+@pytest.mark.slow
 def test_int8_wire_trains_straight_through(tiny):
     """wire='int8' trains via STE: the loss tracks the buffer-wire loss
     within quantization error, gradients point the same way, and a few
@@ -201,6 +204,7 @@ def test_training_with_data_parallel(tiny):
                                        rtol=5e-3, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_bert_training_grads_match(tiny):
     """Integer-token models train through the pipeline too: ids ride the
     f32 transfer buffer, the branch casts them back to int, and the
@@ -305,6 +309,7 @@ def test_trained_params_roundtrip(tiny):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_matches_uninterrupted(tiny, tmp_path):
     """save/load_checkpoint: resumed training walks the same trajectory
     as uninterrupted training (weights AND optimizer moments restored)."""
@@ -367,6 +372,7 @@ def test_checkpoint_before_first_step_restores(tiny, tmp_path):
     assert np.isfinite(t2.step(xs, ys))
 
 
+@pytest.mark.slow
 def test_master_weights_mixed_precision_training(tiny):
     """bf16-compute deployment with f32 master weights: the buffer stays
     f32 (optimizer precision), stages really compute in bf16 (fresh
@@ -411,8 +417,10 @@ def test_master_weights_mixed_precision_training(tiny):
         np.asarray(pipe_bf.run(xs), np.float32), rtol=1e-6, atol=1e-6)
 
 
-@pytest.mark.parametrize("family", ["vgg_tiny", "inception_tiny",
-                                    "mobilenet_tiny"])
+@pytest.mark.parametrize("family", [
+    "vgg_tiny",
+    pytest.param("inception_tiny", marks=pytest.mark.slow),
+    pytest.param("mobilenet_tiny", marks=pytest.mark.slow)])
 def test_training_grads_match_across_families(family):
     """Every model family trains through the pipeline — including the
     branching-DAG Inception whose backward fans in across cut points."""
