@@ -245,9 +245,27 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
     return result
 
 
+def run_chain_overlap_row():
+    """The `chain_overlap` row: delegate to scripts/chain_overlap_smoke.py
+    (multi-process localhost chain, overlapped vs serial node loop) in a
+    subprocess so its CPU-pinned child environment never touches this
+    process's backend.  Returns the smoke's JSON row."""
+    import os
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chain_overlap_smoke.py")
+    proc = subprocess.run([sys.executable, script], capture_output=True,
+                          text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"chain_overlap_smoke rc={proc.returncode}: "
+            f"{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default=",".join(CONFIGS))
+    ap.add_argument("--configs", default=",".join(CONFIGS) + ",chain_overlap")
     ap.add_argument("--tiny", action="store_true",
                     help="force tiny variants (CPU smoke)")
     ap.add_argument("--full", action="store_true",
@@ -264,6 +282,17 @@ def main():
     chunk = args.chunk or (128 if jax.default_backend() == "tpu" else 16)
     for name in args.configs.split(","):
         name = name.strip()
+        if name == "chain_overlap":
+            t0 = time.time()
+            try:
+                r = run_chain_overlap_row()
+            except Exception as e:  # noqa: BLE001 — keep the suite going
+                log(f"{name}: FAILED {type(e).__name__}: {e}")
+                continue
+            log(f"{name}: {r['value']}x vs serial node loop "
+                f"({time.time() - t0:.0f}s)")
+            print(json.dumps(r), flush=True)
+            continue
         if name not in CONFIGS:
             log(f"unknown config {name!r}; have {list(CONFIGS)}")
             continue

@@ -68,6 +68,25 @@ def _obs_finish(args, extra: dict | None = None):
         print(f"metrics -> {args.metrics_out}", file=sys.stderr)
 
 
+def _add_overlap_flags(p):
+    """Transport-overlap tuning shared by ``node`` and ``chain``."""
+    p.add_argument("--no-overlap", action="store_true",
+                   help="serial recv->infer->send node loop (the pre-"
+                        "overlap baseline scripts/chain_overlap_smoke.py "
+                        "measures against)")
+    p.add_argument("--rx-depth", type=int, default=8, metavar="N",
+                   help="decoded frames buffered by each rx channel")
+    p.add_argument("--tx-depth", type=int, default=8, metavar="N",
+                   help="frames queued to each tx channel before the "
+                        "producer blocks")
+    p.add_argument("--inflight", type=int, default=2, metavar="N",
+                   help="stage dispatches kept un-synced per node (JAX "
+                        "async dispatch window)")
+    p.add_argument("--sock-buf", type=int, default=0, metavar="BYTES",
+                   help="SO_SNDBUF/SO_RCVBUF for every data socket "
+                        "(0 = kernel default)")
+
+
 def cmd_models(_args):
     from . import models
     for n in models.__all__:
@@ -199,15 +218,33 @@ def cmd_export(args):
         print(p)
 
 
+def _apply_sock_buf(args):
+    """``--sock-buf N`` sizes SO_SNDBUF/SO_RCVBUF on every data socket of
+    this process — and, via the environment, of any chain children."""
+    if getattr(args, "sock_buf", 0):
+        import os
+
+        from .transport import framed
+        framed.SOCK_SNDBUF = framed.SOCK_RCVBUF = args.sock_buf
+        os.environ["DEFER_SOCK_SNDBUF"] = str(args.sock_buf)
+        os.environ["DEFER_SOCK_RCVBUF"] = str(args.sock_buf)
+
+
 def cmd_node(args):
     from .runtime.node import StageNode
+    from .transport.framed import _codec
 
+    _apply_sock_buf(args)
+    _codec(args.codec)  # loud at boot, not when the first tensor relays
     node = StageNode(args.artifact, args.listen, args.next,
-                     codec=args.codec)
+                     codec=args.codec, overlap=not args.no_overlap,
+                     rx_depth=args.rx_depth, tx_depth=args.tx_depth,
+                     inflight=args.inflight)
     what = (f"stage {node.manifest['index']} ({node.manifest['name']})"
             if node.manifest else "EMPTY (awaiting in-band deploy)")
     print(f"node: {what} listening on "
-          f"{node.address[0]}:{node.address[1]}, next {args.next}",
+          f"{node.address[0]}:{node.address[1]}, next {args.next}"
+          f"{' [serial]' if args.no_overlap else ''}",
           file=sys.stderr, flush=True)
     n = node.serve(connect_timeout_s=args.connect_timeout)
     print(f"node: served {n} tensors; chain drained", file=sys.stderr)
@@ -220,6 +257,7 @@ def cmd_chain(args):
     from .runtime.node import run_chain
 
     _obs_begin(args)
+    _apply_sock_buf(args)
     graph = _get_model(args.model)
     params = graph.init(jax.random.key(0))
     cuts = args.cuts.split(",") if args.cuts else None
@@ -231,7 +269,9 @@ def cmd_chain(args):
 
     t0 = time.perf_counter()
     outs = run_chain(stages, params, xs, batch=args.batch, codec=args.codec,
-                     in_band=args.in_band)
+                     in_band=args.in_band, overlap=not args.no_overlap,
+                     rx_depth=args.rx_depth, tx_depth=args.tx_depth,
+                     inflight=args.inflight)
     dt = time.perf_counter() - t0
 
     fwd = jax.jit(graph.apply)
@@ -242,6 +282,7 @@ def cmd_chain(args):
         "value": round(len(xs) * args.batch / dt, 3),
         "unit": "inferences/sec",
         "stages": len(stages), "codec": args.codec,
+        "overlap": not args.no_overlap,
         "max_abs_err_vs_single_program": worst,
     }))
     _obs_finish(args)
@@ -392,8 +433,10 @@ def main(argv=None):
                     help="successor hop (last node: the dispatcher's "
                          "result port); omit to receive it in-band")
     nd.add_argument("--codec", default="raw",
-                    choices=["raw", "lzb", "bf8", "bf12", "bf16"])
+                    help="hop codec: raw | lzb | bf8/bf12/bf16 | "
+                         "sleep<ms>+<codec> (bench-only delay wrapper)")
     nd.add_argument("--connect-timeout", type=float, default=30.0)
+    _add_overlap_flags(nd)
 
     c = sub.add_parser("chain", help="spawn a local N-process chain and "
                                      "verify vs the single program")
@@ -407,6 +450,7 @@ def main(argv=None):
     c.add_argument("--in-band", action="store_true",
                    help="boot nodes empty; ship artifacts over the "
                         "control handshake")
+    _add_overlap_flags(c)
     _add_obs_flags(c)
 
     t = sub.add_parser("train", help="pipeline-parallel training demo "

@@ -373,8 +373,8 @@ class Defer:
         """
         import socket as _socket
 
-        from ..transport.framed import (K_END, K_TENSOR, recv_frame,
-                                        send_end, send_frame)
+        from ..transport.framed import (K_END, K_TENSOR, configure_socket,
+                                        recv_frame, send_end, send_frame)
         from ..transport.staging import HostStagingRing
 
         pipe = self.build(graph, params, cut_points, num_stages)
@@ -505,6 +505,7 @@ class Defer:
                     conn, _ = srv.accept()
                 except OSError:
                     return  # endpoint shut down
+                configure_socket(conn)
                 client = _Client(conn)
                 clients.append(client)
                 threading.Thread(target=reader, args=(client,),

@@ -157,8 +157,10 @@ class MpmdPipeline:
         outs = self.push(inputs)
         outs.extend(self.flush())
         assert len(outs) == inputs.shape[0], (len(outs), inputs.shape[0])
-        return np.stack([np.asarray(jax.device_get(o), np.float32)
-                         for o in outs])
+        # ONE batched device->host drain: per-output device_get serialized
+        # M transfers; handing the whole list over lets them overlap
+        return np.stack([np.asarray(o, np.float32)
+                         for o in jax.device_get(outs)])
 
     def __call__(self, inputs: np.ndarray) -> np.ndarray:
         return self.run(inputs)

@@ -26,6 +26,7 @@ stages as separate processes/hosts with a network between them.
 
 from __future__ import annotations
 
+import collections
 import os
 import socket
 import subprocess
@@ -38,9 +39,10 @@ from typing import Any, Sequence
 import numpy as np
 
 from ..obs import REGISTRY, new_span_id, tracer
+from ..transport.channel import AsyncReceiver, AsyncSender
 from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
-                                recv_expect, recv_frame, send_ack,
-                                send_ctrl, send_end, send_frame)
+                                configure_socket, recv_expect, recv_frame,
+                                send_ack, send_ctrl, send_end, send_frame)
 
 
 def _connect_retry(host: str, port: int, timeout_s: float = 30.0
@@ -51,7 +53,8 @@ def _connect_retry(host: str, port: int, timeout_s: float = 30.0
     delay = 0.05
     while True:
         try:
-            return socket.create_connection((host, port), timeout=timeout_s)
+            return configure_socket(
+                socket.create_connection((host, port), timeout=timeout_s))
         except OSError:
             if time.monotonic() >= deadline:
                 raise
@@ -76,8 +79,19 @@ class StageNode:
     file instead (the r3/r4 behavior, kept for pre-provisioned hosts).
     """
 
+    #: class-level defaults so instances built via ``__new__`` (tests)
+    #: still serve; the overlapped loop keeps ``inflight`` device
+    #: dispatches un-synced and ``rx_depth``/``tx_depth`` decoded frames
+    #: of queue slack per side
+    overlap: bool = True
+    rx_depth: int = 8
+    tx_depth: int = 8
+    inflight: int = 2
+
     def __init__(self, artifact: str | None, listen: str,
-                 next_hop: str | None, *, codec: str = "raw"):
+                 next_hop: str | None, *, codec: str = "raw",
+                 overlap: bool = True, rx_depth: int = 8,
+                 tx_depth: int = 8, inflight: int = 2):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
         # process exists
@@ -90,6 +104,10 @@ class StageNode:
             self.prog = load_stage_program(artifact)
         self.next_hop = _parse_hostport(next_hop) if next_hop else None
         self.codec = codec
+        self.overlap = overlap
+        self.rx_depth = rx_depth
+        self.tx_depth = tx_depth
+        self.inflight = max(1, inflight)
         self.processed = 0    # tensors relayed, lifetime
         self.reweights = 0    # weights-only re-pushes accepted
         #: trace-context K_CTRL received from upstream, held until this
@@ -101,8 +119,18 @@ class StageNode:
     def manifest(self):
         return None if self.prog is None else self.prog.manifest
 
-    def _handle_ctrl(self, conn, msg: dict) -> bool:
+    def _span_label(self) -> str:
+        """Span/track prefix for this node's rx/tx/infer telemetry."""
+        m = self.manifest
+        return (f"stage{m['index']}" if m is not None
+                else f"node{self.address[1]}")
+
+    def _handle_ctrl(self, conn, msg: dict, recv=None) -> bool:
         """One control command; True if the connection should keep serving.
+
+        ``recv`` supplies the follow-up frame of multi-frame commands
+        (deploy/reweight blobs); the overlapped loop passes its rx-queue
+        getter because the channel's rx thread owns all socket reads.
 
         deploy:   {"cmd": "deploy", "next": "host:port", "codec": ...}
                   followed by a K_BYTES artifact blob -> load, ACK.
@@ -122,9 +150,19 @@ class StageNode:
                   stage's spans into one exportable trace.
         """
         from ..utils.export import load_stage_program
+
+        def _expect(kind):
+            if recv is None:
+                return recv_expect(conn, kind)
+            got, value = recv()
+            if got != kind:
+                raise ConnectionError(
+                    f"expected frame kind {kind}, got {got}")
+            return value
+
         cmd = msg.get("cmd")
         if cmd == "deploy":
-            blob = recv_expect(conn, K_BYTES)
+            blob = _expect(K_BYTES)
             self.prog = load_stage_program(blob)
             if msg.get("next"):
                 self.next_hop = _parse_hostport(msg["next"])
@@ -135,7 +173,7 @@ class StageNode:
         if cmd == "reweight":
             if self.prog is None:
                 raise ValueError("reweight before deploy")
-            self.prog.reweight(recv_expect(conn, K_BYTES))
+            self.prog.reweight(_expect(K_BYTES))
             self.reweights += 1
             send_ack(conn)
             return True
@@ -176,6 +214,12 @@ class StageNode:
                 "rx_bytes": reg.counter("transport.rx_bytes").value,
                 "infer_latency_s":
                     reg.histogram("node.infer_s").summary(),
+                # overlap telemetry: queue occupancy of the async channel
+                # layer and the un-synced device-dispatch window
+                "overlap": self.overlap,
+                "rx_queue_depth": reg.gauge("node.rx_queue_depth").value,
+                "tx_queue_depth": reg.gauge("node.tx_queue_depth").value,
+                "inflight": reg.gauge("node.inflight").value,
             })
             return True
         raise ValueError(f"unknown control command {msg!r}")
@@ -200,6 +244,7 @@ class StageNode:
 
         def worker(conn):
             try:
+                configure_socket(conn)
                 n = self._serve_conn(conn, connect_timeout_s)
                 if n is not None:
                     done.put(n)
@@ -229,7 +274,157 @@ class StageNode:
             self._srv.close()
 
     def _serve_conn(self, conn, connect_timeout_s: float) -> int | None:
-        """One connection: None if it was control-only, else tensor count."""
+        """One connection: None if it was control-only, else tensor count.
+
+        ``overlap=True`` (default) runs the three-phase overlapped loop
+        (:meth:`_serve_conn_overlapped`); ``overlap=False`` keeps the
+        strictly serial recv -> infer -> send loop as the measurable
+        baseline (``--no-overlap``, ``scripts/chain_overlap_smoke.py``).
+        """
+        if self.overlap:
+            return self._serve_conn_overlapped(conn, connect_timeout_s)
+        return self._serve_conn_serial(conn, connect_timeout_s)
+
+    def _serve_conn_overlapped(self, conn,
+                               connect_timeout_s: float) -> int | None:
+        """Three-phase overlap: rx thread -> compute loop -> tx thread.
+
+        An :class:`AsyncReceiver` decodes upstream frames into a bounded
+        queue while this thread computes, and an :class:`AsyncSender`
+        encodes/sends relayed tensors from a bounded queue — so the rx of
+        microbatch j+1, the compute of j, and the tx of j-1 run
+        concurrently, and per-hop latency tends to max(rx, compute, tx)
+        instead of their sum.  The compute loop additionally keeps up to
+        ``inflight`` stage dispatches un-synced (JAX async dispatch): the
+        host-side ``np.asarray`` sync of output j-1 overlaps the device
+        compute of j.  Bounded queues preserve end-to-end backpressure —
+        a stuck downstream fills the tx queue, stalls this loop, fills
+        the rx queue, and TCP pushes back upstream.
+
+        ``node.infer_s`` here measures issue-to-materialize (device queue
+        included), matching what the overlap actually hides.
+        """
+        out = None
+        tx = None
+        n = 0                   # tensors relayed downstream
+        seq = 0                 # tensors received
+        streamed = False
+        infer_hist = REGISTRY.histogram("node.infer_s")
+        inflight_g = REGISTRY.gauge("node.inflight")
+        #: issued-but-unsynced stage outputs, oldest first
+        pending: collections.deque = collections.deque()
+        # no gauge yet: most connections are short-lived control round
+        # trips whose rx channel would clobber the data stream's reading;
+        # the gauge is bound once this connection proves to be the stream
+        rx = AsyncReceiver(conn, depth=self.rx_depth,
+                           span=self._span_label)
+
+        def drain_one():
+            nonlocal n, streamed
+            t0, s, y = pending.popleft()
+            inflight_g.v = len(pending)
+            y = np.asarray(y)  # host sync of the OLDEST in-flight output
+            dt = time.perf_counter() - t0
+            infer_hist.record(dt)
+            tr = tracer()
+            if tr.enabled:
+                tr.record(
+                    f"stage{self.manifest['index']}.infer", t0, dt,
+                    {"seq": s, "stage": self.manifest["index"]})
+            self.processed += 1  # before the send: a stats query can
+            #   race the relay of the final tensor otherwise
+            tx.send(y)
+            n += 1
+            streamed = True
+
+        import queue as _q
+
+        try:
+            while True:
+                if pending:
+                    # compute-ahead only while input is immediately
+                    # available: an idle upstream means the window must
+                    # drain NOW, or the stream's tail stalls in the node
+                    try:
+                        kind, value = rx.get_nowait()
+                    except _q.Empty:
+                        drain_one()
+                        continue
+                else:
+                    kind, value = rx.get()
+                if kind == K_END:
+                    while pending:
+                        drain_one()
+                    if streamed:
+                        # END + join: every relayed frame is on the wire
+                        # before the finally block closes the socket
+                        tx.close(timeout=connect_timeout_s)
+                        return n
+                    return None  # control connection closing
+                if kind == K_CTRL:
+                    is_trace = (isinstance(value, dict)
+                                and value.get("cmd") == "trace")
+                    if is_trace:
+                        # relay order: everything received before this
+                        # ctrl frame must reach downstream ahead of it
+                        while pending:
+                            drain_one()
+                    self._handle_ctrl(conn, value, recv=rx.get)
+                    if is_trace and tx is not None:
+                        # downstream already connected (e.g. a second
+                        # traced stream on a live chain): cascade the new
+                        # context now, not just at connection open
+                        tx.send_ctrl(self._pending_trace)
+                    continue
+                if kind != K_TENSOR:
+                    raise ValueError(f"unexpected frame kind {kind}")
+                if self.prog is None:
+                    raise ValueError(
+                        "data frame before any stage artifact (boot with "
+                        "--artifact or deploy in-band first)")
+                if out is None:
+                    if self.next_hop is None:
+                        raise ValueError("no next hop configured")
+                    out = _connect_retry(*self.next_hop,
+                                         timeout_s=connect_timeout_s)
+                    rx.bind_gauge("node.rx_queue_depth")
+                    tx = AsyncSender(out, depth=self.tx_depth,
+                                     codec=self.codec,
+                                     gauge="node.tx_queue_depth",
+                                     span=self._span_label)
+                    if self._pending_trace is not None:
+                        # cascade the dispatcher's trace context down the
+                        # chain ahead of the first relayed tensor
+                        tx.send_ctrl(self._pending_trace)
+                want = tuple(self.manifest["in_shape"])
+                if tuple(value.shape[1:]) != want:
+                    raise ValueError(
+                        f"stage {self.manifest['index']} expects sample "
+                        f"shape {want}, got {tuple(value.shape[1:])}")
+                t0 = time.perf_counter()
+                pending.append((t0, seq, self.prog(value)))  # no sync yet
+                seq += 1
+                inflight_g.v = len(pending)
+                while len(pending) >= self.inflight:
+                    drain_one()
+        except Exception as e:  # noqa: BLE001 — see below
+            if streamed:
+                raise  # upstream died / corrupted mid-stream: loud
+            # a connection that never became the data stream must not be
+            # able to kill a serving node: port scanners and malformed
+            # control peers are logged and dropped.  The remote side still
+            # fails loudly — its recv gets a cut connection, no ACK/END.
+            print(f"node: dropped connection before streaming: {e!r}",
+                  file=sys.stderr, flush=True)
+            return None
+        finally:
+            if out is not None:
+                out.close()
+
+    def _serve_conn_serial(self, conn, connect_timeout_s: float) -> int | None:
+        """The pre-overlap serial loop: per tensor, rx + decode, compute
+        with an immediate host sync, encode + tx — phases pay their sum.
+        Kept as the baseline the overlap speedup is measured against."""
         out = None
         n = 0
         streamed = False
@@ -289,10 +484,6 @@ class StageNode:
         except Exception as e:  # noqa: BLE001 — see below
             if streamed:
                 raise  # upstream died / corrupted mid-stream: loud
-            # a connection that never became the data stream must not be
-            # able to kill a serving node: port scanners and malformed
-            # control peers are logged and dropped.  The remote side still
-            # fails loudly — its recv gets a cut connection, no ACK/END.
             print(f"node: dropped connection before streaming: {e!r}",
                   file=sys.stderr, flush=True)
             return None
@@ -311,12 +502,18 @@ class ChainDispatcher:
     """
 
     #: the ONE timeout default; also covers partially-constructed
-    #: instances (tests build via __new__ around socketpairs)
+    #: instances (tests build via __new__ around socketpairs) — as do the
+    #: channel defaults below
     timeout_s: float = 180.0
+    tx_depth: int = 8
+    rx_depth: int = 8
+    _tx_chan: AsyncSender | None = None
+    _rx_chan: AsyncReceiver | None = None
 
     def __init__(self, first_hop: str, *, listen: str = "127.0.0.1:0",
                  codec: str = "raw", window: int = 64,
-                 timeout_s: float | None = None):
+                 timeout_s: float | None = None,
+                 tx_depth: int = 8, rx_depth: int = 8):
         if timeout_s is not None:
             self.timeout_s = timeout_s
         host, port = _parse_hostport(listen)
@@ -327,14 +524,27 @@ class ChainDispatcher:
         self.first_hop = first_hop
         self.codec = codec
         self.window = window
+        self.tx_depth = tx_depth
+        self.rx_depth = rx_depth
         self._send_sock: socket.socket | None = None
         self._res_conn: socket.socket | None = None
+        self._tx_chan = None
+        self._rx_chan = None
 
     def _ensure_connected(self):
         if self._send_sock is None:
             # generous: every node in the chain cold-imports jax first
             self._send_sock = _connect_retry(
                 *_parse_hostport(self.first_hop), timeout_s=self.timeout_s)
+        if self._tx_chan is None:
+            # encode + send happen on the channel's tx thread, so the
+            # feed loop's np.asarray and the wire overlap (and the END in
+            # close() rides the same ordered queue)
+            self._tx_chan = AsyncSender(self._send_sock,
+                                        depth=self.tx_depth,
+                                        codec=self.codec,
+                                        gauge="chain.tx_queue_depth",
+                                        span="chain")
         # the result connection is accepted lazily in _recv_tensor: the
         # last node only dials back once its first tensor arrives, so
         # accepting before sending anything would deadlock the chain
@@ -346,8 +556,11 @@ class ChainDispatcher:
         ``window`` in flight, released as results land) while this thread
         drains results concurrently — a slow stage applies backpressure
         through the window instead of stalling the feed loop mid-send
-        (r4 verdict weakness #7).  The result socket's own timeout bounds
-        each recv, so a dead chain still fails rather than hangs.
+        (r4 verdict weakness #7).  Encoding happens on the tx channel's
+        own thread and result decoding on the rx channel's, so feed,
+        encode, the chain itself, and the result drain all overlap with
+        bounded in-flight depth.  Per-``get`` timeouts on the result
+        channel keep a dead chain failing rather than hanging.
 
         With tracing enabled (``defer_tpu.obs.enable_tracing``), the call
         injects its trace context as a K_CTRL frame ahead of the first
@@ -363,9 +576,9 @@ class ChainDispatcher:
             # pre-allocate the root span id so remote stages can parent
             # under a span recorded only when the stream completes
             root_span = new_span_id()
-            send_ctrl(self._send_sock,
-                      {"cmd": "trace", "trace_id": tr.trace_id,
-                       "span_id": root_span})
+            self._tx_chan.send_ctrl(
+                {"cmd": "trace", "trace_id": tr.trace_id,
+                 "span_id": root_span})
         outs: list[np.ndarray] = []
         window = threading.Semaphore(self.window)
         sent = [0]
@@ -385,8 +598,7 @@ class ChainDispatcher:
                             f"flight — a stage is stuck")
                     if rx_failed.is_set():
                         return  # woken by the error path, not a result
-                    send_frame(self._send_sock, np.asarray(x),
-                               codec=self.codec)
+                    self._tx_chan.send(np.asarray(x))
                     sent[0] += 1
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 err.append(e)
@@ -502,16 +714,29 @@ class ChainDispatcher:
     def _recv_tensor(self) -> np.ndarray:
         """One in-order result frame; loud protocol check (not an assert:
         ``python -O`` strips asserts, and an early END from a node that died
-        mid-stream must raise, not silently mis-drain)."""
+        mid-stream must raise, not silently mis-drain).
+
+        Results arrive through an :class:`AsyncReceiver`: the decode of
+        result j+1 happens on the channel's rx thread while this thread
+        hands j back to the caller.  The per-``get`` timeout keeps the
+        dead-chain-fails-not-hangs contract; the socket itself stays
+        blocking so an idle (but healthy) chain never desyncs mid-frame.
+        """
         if self._res_conn is None:
             self._res_conn, _ = self._res_srv.accept()
-            self._res_conn.settimeout(self.timeout_s)
-        kind, y = recv_frame(self._res_conn)
+            configure_socket(self._res_conn)
+        if self._rx_chan is None:
+            self._res_conn.settimeout(None)
+            self._rx_chan = AsyncReceiver(self._res_conn,
+                                          depth=self.rx_depth,
+                                          gauge="chain.rx_queue_depth",
+                                          span="chain")
+        kind, y = self._rx_chan.get(timeout=self.timeout_s)
         while kind == K_CTRL and isinstance(y, dict) \
                 and y.get("cmd") == "trace":
             # the last node cascaded the trace context to the result hop;
             # informational — the dispatcher originated it
-            kind, y = recv_frame(self._res_conn)
+            kind, y = self._rx_chan.get(timeout=self.timeout_s)
         if kind != K_TENSOR:
             raise ConnectionError(
                 f"chain returned frame kind {kind!r} while results were "
@@ -548,7 +773,13 @@ class ChainDispatcher:
         BrokenPipe/EOF from the teardown itself."""
         try:
             if self._send_sock is not None:
-                send_end(self._send_sock)
+                if self._tx_chan is not None:
+                    # the END rides the ordered tx queue behind any
+                    # trailing frames; close() joins the tx thread so it
+                    # is on the wire before we wait for the cascaded echo
+                    self._tx_chan.close(timeout=min(10.0, self.timeout_s))
+                else:
+                    send_end(self._send_sock)
                 if self._res_conn is None:
                     # nothing was ever received: still accept the last
                     # node's dial-back so its cascaded END completes
@@ -562,7 +793,11 @@ class ChainDispatcher:
                     # drain any leftover in-flight frames until the END
                     # cascades through
                     while True:
-                        kind, _ = recv_frame(self._res_conn)
+                        if self._rx_chan is not None:
+                            kind, _ = self._rx_chan.get(
+                                timeout=self.timeout_s)
+                        else:
+                            kind, _ = recv_frame(self._res_conn)
                         if kind == K_END:
                             break
         except (OSError, ConnectionError, ValueError):
@@ -587,7 +822,9 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               *, batch: int = 1, codec: str = "raw",
               artifact_dir: str | None = None,
               env: dict[str, str] | None = None,
-              in_band: bool = False) -> list[np.ndarray]:
+              in_band: bool = False, overlap: bool = True,
+              rx_depth: int | None = None, tx_depth: int | None = None,
+              inflight: int | None = None) -> list[np.ndarray]:
     """Export, spawn one OS process per stage, stream, and tear down.
 
     The one-call analogue of the reference's whole deployment procedure
@@ -626,10 +863,15 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
         child_env.update(env)
 
+        tuning = [] if overlap else ["--no-overlap"]
+        for flag, v in (("--rx-depth", rx_depth), ("--tx-depth", tx_depth),
+                        ("--inflight", inflight)):
+            if v is not None:
+                tuning += [flag, str(v)]
         if in_band:
             argv_for = lambda i: [  # noqa: E731 — tiny per-node argv
                 sys.executable, "-m", "defer_tpu", "node",
-                "--listen", f"127.0.0.1:{ports[i]}"]
+                "--listen", f"127.0.0.1:{ports[i]}"] + tuning
         else:
             paths = export_pipeline(stages, params, artifact_dir,
                                     batch=batch)
@@ -639,7 +881,7 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                 "--listen", f"127.0.0.1:{ports[i]}",
                 "--next", (f"127.0.0.1:{ports[i + 1]}" if i + 1 < n
                            else f"127.0.0.1:{result_port}"),
-                "--codec", codec]
+                "--codec", codec] + tuning
 
         procs = []
         for i in range(n):
@@ -653,7 +895,12 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
 
         disp = ChainDispatcher(f"127.0.0.1:{ports[0]}",
                                listen=f"127.0.0.1:{result_port}",
-                               codec=codec)
+                               codec=codec,
+                               # the CLI depth flags tune BOTH ends: the
+                               # nodes (via argv) and the dispatcher's own
+                               # feed/drain channels
+                               tx_depth=tx_depth if tx_depth else 8,
+                               rx_depth=rx_depth if rx_depth else 8)
         try:
             if in_band:
                 disp.deploy(stages, params,

@@ -1,2 +1,3 @@
+from .channel import AsyncReceiver, AsyncSender, ChannelError
 from .framed import (K_BYTES, K_END, K_TENSOR, TensorClient, TensorServer,
-                     recv_frame, send_end, send_frame)
+                     configure_socket, recv_frame, send_end, send_frame)

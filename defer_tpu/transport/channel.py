@@ -1,0 +1,268 @@
+"""Async double-buffered transport channels: overlap rx, compute, and tx.
+
+A serial stage loop pays rx + decode + compute + encode + tx per tensor,
+so per-hop latency is the *sum* of the phases.  The paper's pipeline claim
+(+53% ResNet50 throughput at 8 nodes) needs every node to process
+microbatch *j* while receiving *j+1* and relaying *j-1* — per-hop cost is
+then the *max* of the phases.  This module supplies the two halves of that
+overlap for any framed socket:
+
+* :class:`AsyncReceiver` — a daemon thread that reads *and decodes* frames
+  into a bounded queue.  A full queue parks the thread in ``put``, which
+  stops its reads; TCP flow control then pushes back on the upstream
+  sender, so backpressure is preserved end to end with at most
+  ``depth`` decoded frames of slack.
+* :class:`AsyncSender` — a bounded queue drained by a daemon thread that
+  *encodes and sends*.  A full queue blocks the producer (``send``), so a
+  slow wire stalls the compute loop after ``depth`` frames, never later.
+
+Both sides surface worker-thread failures on the caller's thread: the
+receiver's ``get`` re-raises the exact exception that killed the rx
+thread; the sender's next ``send``/``flush`` raises :class:`ChannelError`
+chained to the tx thread's failure (and the dead thread drains the queue
+so a producer parked in ``send`` always wakes).
+
+Telemetry: pass ``gauge="node.rx_queue_depth"`` to publish the queue's
+occupancy as a registry gauge, and ``span=<name or callable>`` to record a
+``<name>.rx`` / ``<name>.tx`` span per frame when the process tracer is
+enabled — the Perfetto view of rx/compute/tx actually overlapping.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable
+
+from ..obs import REGISTRY, tracer
+from .framed import K_END, recv_frame, send_ctrl, send_end, send_frame
+
+#: rx-queue sentinel: the thread died, ``err`` holds why
+_ERR = object()
+#: tx-queue item kinds
+_TENSOR, _CTRL, _END, _FLUSH = 0, 1, 2, 3
+
+
+class ChannelError(ConnectionError):
+    """A channel worker thread died; the original failure is ``__cause__``."""
+
+
+def _resolve_label(span) -> Callable[[], str] | None:
+    if span is None:
+        return None
+    return span if callable(span) else (lambda: span)
+
+
+class AsyncReceiver:
+    """Daemon rx thread: recv + decode into a bounded in-order queue.
+
+    The thread exits after delivering a ``K_END`` frame (the stream is
+    over) or on error.  ``get`` never hangs past its timeout and re-raises
+    the rx thread's failure once the queue is drained.
+    """
+
+    def __init__(self, sock, *, depth: int = 8, gauge: str | None = None,
+                 span=None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._sock = sock
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._gauge = REGISTRY.gauge(gauge) if gauge else None
+        self._span = _resolve_label(span)
+        self.err: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="channel-rx")
+        self._thread.start()
+
+    def bind_gauge(self, name: str) -> None:
+        """Start publishing queue occupancy under ``name`` — for callers
+        that only later learn this connection is worth monitoring (a node
+        binds its gauge once a connection becomes THE data stream, so
+        short-lived control connections never clobber the reading)."""
+        self._gauge = REGISTRY.gauge(name)
+
+    def _run(self):
+        n = 0
+        try:
+            while True:
+                t0 = time.perf_counter()
+                kind, value = recv_frame(self._sock)
+                tr = tracer()
+                if tr.enabled and self._span is not None:
+                    tr.record(f"{self._span()}.rx", t0,
+                              time.perf_counter() - t0, {"seq": n})
+                n += 1
+                self._q.put((kind, value))
+                if self._gauge is not None:
+                    self._gauge.v = self._q.qsize()
+                if kind == K_END:
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in get()
+            self.err = e
+            try:
+                self._q.put_nowait(_ERR)
+            except queue.Full:
+                pass  # get() checks err once the queue drains
+
+    def get(self, timeout: float | None = None) -> tuple:
+        """Next (kind, value) in arrival order; re-raises the rx thread's
+        failure, raises TimeoutError past ``timeout`` (None = forever)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self.err is not None and self._q.empty():
+                    raise self.err
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no frame within {timeout:.1f}s")
+                continue
+            return self._unwrap(item)
+
+    def get_nowait(self) -> tuple:
+        """Non-blocking :meth:`get`; raises ``queue.Empty`` when no frame
+        is ready (the consumer's cue to spend the idle time elsewhere,
+        e.g. draining its compute window)."""
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            if self.err is not None:
+                raise self.err from None
+            raise
+        return self._unwrap(item)
+
+    def _unwrap(self, item) -> tuple:
+        if self._gauge is not None:
+            self._gauge.v = self._q.qsize()
+        if item is _ERR:
+            raise self.err
+        return item
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+class AsyncSender:
+    """Bounded tx queue drained by a daemon encode+send thread.
+
+    ``send``/``send_ctrl``/``send_end`` enqueue in call order; a full
+    queue blocks the caller (bounded in-flight depth).  After the tx
+    thread dies, every subsequent call raises :class:`ChannelError` and
+    the queue is drained so a parked producer always wakes.
+    """
+
+    def __init__(self, sock, *, depth: int = 8, codec: str = "raw",
+                 gauge: str | None = None, span=None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._sock = sock
+        self.codec = codec
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._gauge = REGISTRY.gauge(gauge) if gauge else None
+        self._span = _resolve_label(span)
+        self.err: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="channel-tx")
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+
+    def send(self, arr) -> None:
+        """Enqueue one tensor frame (encode + send happen on the tx
+        thread, under this sender's codec)."""
+        self._put((_TENSOR, arr))
+
+    def send_ctrl(self, msg: dict) -> None:
+        self._put((_CTRL, msg))
+
+    def send_end(self) -> None:
+        """Enqueue the END frame; the tx thread exits after sending it."""
+        self._put((_END, None))
+
+    def close(self, timeout: float | None = None) -> None:
+        """Send END (after everything already queued) and wait for the tx
+        thread to put it on the wire and exit — the caller may close the
+        socket afterwards without racing a buffered frame."""
+        self.send_end()
+        self._thread.join(timeout)
+        if self.err is not None:
+            raise ChannelError("transport tx thread died") from self.err
+        if self._thread.is_alive():
+            raise TimeoutError(f"tx queue did not drain in {timeout:.1f}s")
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Block until everything enqueued so far is on the wire (or raise
+        the tx thread's failure / TimeoutError)."""
+        ev = threading.Event()
+        self._put((_FLUSH, ev))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not ev.wait(0.05):
+            if self.err is not None:
+                raise ChannelError("transport tx thread died") from self.err
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"tx queue did not drain in {timeout:.1f}s")
+        if self.err is not None:
+            raise ChannelError("transport tx thread died") from self.err
+
+    def _put(self, item) -> None:
+        while True:
+            if self.err is not None:
+                raise ChannelError("transport tx thread died") from self.err
+            try:
+                self._q.put(item, timeout=0.05)
+            except queue.Full:
+                continue
+            if self._gauge is not None:
+                self._gauge.v = self._q.qsize()
+            return
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    # -- tx thread ----------------------------------------------------------
+
+    def _run(self):
+        n = 0
+        try:
+            while True:
+                kind, v = self._q.get()
+                if self._gauge is not None:
+                    self._gauge.v = self._q.qsize()
+                if kind == _FLUSH:
+                    v.set()
+                    continue
+                t0 = time.perf_counter()
+                if kind == _TENSOR:
+                    send_frame(self._sock, v, codec=self.codec)
+                elif kind == _CTRL:
+                    send_ctrl(self._sock, v)
+                else:
+                    send_end(self._sock)
+                tr = tracer()
+                if tr.enabled and self._span is not None and kind == _TENSOR:
+                    tr.record(f"{self._span()}.tx", t0,
+                              time.perf_counter() - t0, {"seq": n})
+                n += 1
+                if kind == _END:
+                    # release any flush marker enqueued after the END so
+                    # a racing flush() can never hang on a dead thread
+                    while True:
+                        try:
+                            k2, v2 = self._q.get_nowait()
+                        except queue.Empty:
+                            return
+                        if k2 == _FLUSH:
+                            v2.set()
+        except BaseException as e:  # noqa: BLE001 — surfaced in _put/flush
+            self.err = e
+            # wake any parked producer and release pending flush waiters;
+            # items still queued are dropped (the wire is dead anyway)
+            while True:
+                try:
+                    kind, v = self._q.get_nowait()
+                except queue.Empty:
+                    return
+                if kind == _FLUSH:
+                    v.set()  # flush re-checks err after the event fires

@@ -20,6 +20,7 @@ Design differences from the reference, on purpose:
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -30,6 +31,49 @@ import numpy as np
 
 from ..codec import BlockFloatCodec, Codec, LosslessCodec, PipelineCodec, RawCodec
 from ..obs import REGISTRY
+
+
+def _env_int(name: str) -> int:
+    v = os.environ.get(name, "")
+    return int(v) if v else 0
+
+
+#: default kernel socket buffer sizes for data sockets (bytes; 0 = leave
+#: the kernel default).  Overridable per process via environment or the
+#: ``--sock-buf`` CLI flag; big cross-host hops with high bandwidth-delay
+#: product want these raised well past the Linux default.
+SOCK_SNDBUF = _env_int("DEFER_SOCK_SNDBUF")
+SOCK_RCVBUF = _env_int("DEFER_SOCK_RCVBUF")
+
+
+def configure_socket(sock: socket.socket, *, nodelay: bool = True,
+                     sndbuf: int | None = None,
+                     rcvbuf: int | None = None) -> socket.socket:
+    """Tune a data socket: TCP_NODELAY plus optional SO_SNDBUF/SO_RCVBUF.
+
+    Every frame here is a complete message the peer is waiting on —
+    small K_CTRL/K_ACK/K_END frames under Nagle + delayed ACK add up to
+    ~40 ms stalls per handshake on localhost chains, so NODELAY is the
+    default on every data socket.  Non-TCP sockets (AF_UNIX socketpairs
+    in tests) are left untouched.
+    """
+    if sndbuf is None:
+        sndbuf = SOCK_SNDBUF
+    if rcvbuf is None:
+        rcvbuf = SOCK_RCVBUF
+    try:
+        if nodelay:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # not TCP (e.g. AF_UNIX)
+    try:
+        if sndbuf:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+        if rcvbuf:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+    except OSError:
+        pass
+    return sock
 
 #: frame kinds
 K_TENSOR = 1
@@ -55,6 +99,45 @@ _ENC_HIST = REGISTRY.histogram("codec.encode_s")
 _DEC_HIST = REGISTRY.histogram("codec.decode_s")
 
 
+class _SleepCodec(Codec):
+    """Test/bench-only wrapper: a real codec plus a fixed per-side delay.
+
+    ``sleep<ms>+<codec>`` models per-hop phases a CPU-bound localhost
+    chain cannot express (accelerator compute, NIC serialization): the
+    sleep occupies wall time without occupying the CPU, which is exactly
+    the resource profile the rx/compute/tx overlap is built for.  The
+    wire payload is byte-identical to the wrapped codec's.  Used by
+    ``scripts/chain_overlap_smoke.py``; never pick it for deployments.
+    """
+
+    name = "sleep"
+
+    def __init__(self, delay_s: float, inner: Codec):
+        self._delay_s = delay_s
+        self._inner = inner
+
+    def encode(self, arr):
+        time.sleep(self._delay_s)
+        return self._inner.encode(arr)
+
+    def decode(self, data, shape, dtype):
+        time.sleep(self._delay_s)
+        return self._inner.decode(data, shape, dtype)
+
+
+def _make_codec(name: str) -> Codec:
+    if name == "raw":
+        return RawCodec()
+    if name == "lzb":
+        return LosslessCodec()
+    if name.startswith("bf"):
+        return PipelineCodec(bits=int(name[2:]))
+    if name.startswith("sleep"):
+        head, _, inner = name.partition("+")
+        return _SleepCodec(float(head[5:]) / 1e3, _make_codec(inner or "raw"))
+    raise ValueError(f"unknown codec {name!r}")
+
+
 def _codec(name: str) -> Codec:
     c = _CODECS.get(name)
     if c is not None:
@@ -62,15 +145,7 @@ def _codec(name: str) -> Codec:
     with _CODECS_LOCK:
         c = _CODECS.get(name)
         if c is None:
-            if name == "raw":
-                c = RawCodec()
-            elif name == "lzb":
-                c = LosslessCodec()
-            elif name.startswith("bf"):
-                c = PipelineCodec(bits=int(name[2:]))
-            else:
-                raise ValueError(f"unknown codec {name!r}")
-            _CODECS[name] = c
+            c = _CODECS[name] = _make_codec(name)
     return c
 
 
@@ -79,10 +154,29 @@ _HDR = struct.Struct(">BBBBQ")
 MAX_FRAME = 1 << 34  # 16 GiB sanity bound
 
 
+def _sendv(sock: socket.socket, *parts) -> None:
+    """Scatter-gather sendall (``sendmsg``/writev): the frame goes out as
+    one syscall per kernel-buffer fill with NO concatenation copy of the
+    payload — the old ``hdr + cname + meta + payload`` built a second
+    multi-megabyte buffer per activation frame."""
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:  # platform without sendmsg: one copy, one sendall
+        sock.sendall(b"".join(bytes(p) for p in parts))
+        return
+    views = [memoryview(p).cast("B") for p in parts if len(p)]
+    while views:
+        n = sendmsg(views)
+        while views and n >= len(views[0]):
+            n -= len(views[0])
+            del views[0]
+        if n:
+            views[0] = views[0][n:]
+
+
 def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
     """Send one typed frame (tensor or raw bytes)."""
     if isinstance(arr_or_bytes, (bytes, bytearray, memoryview)):
-        kind, payload = K_BYTES, bytes(arr_or_bytes)
+        kind, payload = K_BYTES, arr_or_bytes  # scatter-gather: no copy
         meta = b""
         cname = b"raw"
         ndim = 0
@@ -90,17 +184,26 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
         arr = np.asarray(arr_or_bytes)
         kind = K_TENSOR
         t0 = time.perf_counter()
-        payload = _codec(codec).encode(arr)
+        if codec == "raw":
+            # zero-copy: the payload is a view of the array's own buffer
+            # (ascontiguousarray is a no-op for the usual contiguous case)
+            try:
+                payload = memoryview(np.ascontiguousarray(arr)).cast("B")
+            except (TypeError, ValueError):  # 0-d / exotic dtypes
+                payload = _codec(codec).encode(arr)
+        else:
+            payload = _codec(codec).encode(arr)
         _ENC_HIST.record(time.perf_counter() - t0)
         cname = codec.encode()
         dt = arr.dtype.str.encode()
         meta = dt + b"".join(struct.pack(">Q", s) for s in arr.shape)
         ndim = arr.ndim
     dt_len = len(meta) - 8 * ndim if kind == K_TENSOR else 0
-    hdr = _HDR.pack(kind, len(cname), dt_len, ndim, len(payload))
-    sock.sendall(hdr + cname + meta + payload)
+    plen = payload.nbytes if isinstance(payload, memoryview) else len(payload)
+    hdr = _HDR.pack(kind, len(cname), dt_len, ndim, plen)
+    _sendv(sock, hdr + cname + meta, payload)
     _TX_FRAMES.n += 1
-    _TX_BYTES.n += _HDR.size + len(cname) + len(meta) + len(payload)
+    _TX_BYTES.n += _HDR.size + len(cname) + len(meta) + plen
 
 
 def send_end(sock: socket.socket):
@@ -128,7 +231,10 @@ def recv_expect(sock: socket.socket, kind: int) -> Any:
     return value
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_into(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes into a fresh buffer — returned as the
+    bytearray itself, NOT a ``bytes(buf)`` copy: tensor payloads go
+    straight to ``np.frombuffer``/codec decode over this buffer."""
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -137,13 +243,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         if r == 0:
             raise ConnectionError("peer closed mid-frame")
         got += r
-    return bytes(buf)
+    return buf
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    return bytes(_recv_into(sock, n))
 
 
 def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     """Receive one frame -> (kind, payload).  Tensor frames are decoded to
     ndarrays; K_END returns (K_END, None)."""
-    kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_into(sock, _HDR.size))
     _RX_FRAMES.n += 1
     _RX_BYTES.n += _HDR.size + clen + dlen + 8 * ndim + plen
     if kind == K_END:
@@ -154,16 +264,21 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
         raise ValueError(f"frame of {plen} bytes exceeds bound")
     if kind == K_CTRL:
         import json as _json
-        return K_CTRL, _json.loads(_recv_exact(sock, plen).decode())
-    cname = _recv_exact(sock, clen).decode()
+        return K_CTRL, _json.loads(_recv_into(sock, plen).decode())
+    cname = _recv_into(sock, clen).decode()
     if kind == K_BYTES:
         return K_BYTES, _recv_exact(sock, plen)
-    dt = np.dtype(_recv_exact(sock, dlen).decode())
-    shape = tuple(struct.unpack(">Q", _recv_exact(sock, 8))[0]
+    dt = np.dtype(_recv_into(sock, dlen).decode())
+    shape = tuple(struct.unpack(">Q", _recv_into(sock, 8))[0]
                   for _ in range(ndim))
-    payload = _recv_exact(sock, plen)
+    buf = _recv_into(sock, plen)
     t0 = time.perf_counter()
-    value = _codec(cname).decode(payload, shape, dt)
+    if cname == "raw":
+        # zero-copy: the returned ndarray is a view over the rx buffer
+        # (freshly allocated per frame, so it is exclusively owned)
+        value = np.frombuffer(buf, dtype=dt).reshape(shape)
+    else:
+        value = _codec(cname).decode(memoryview(buf), shape, dt)
     _DEC_HIST.record(time.perf_counter() - t0)
     return K_TENSOR, value
 
@@ -184,6 +299,7 @@ class TensorServer:
         handler(array) as a tensor frame.  Returns after the client's END
         frame (echoed back)."""
         conn, _ = self._srv.accept()
+        configure_socket(conn)
         try:
             while True:
                 kind, value = recv_frame(conn)
@@ -206,7 +322,7 @@ class TensorClient:
     hardcoded 600 s default is kept for compatibility."""
 
     def __init__(self, host: str, port: int, *, timeout_s: float = 600.0):
-        self._sock = socket.create_connection((host, port))
+        self._sock = configure_socket(socket.create_connection((host, port)))
         self.timeout_s = timeout_s
 
     def infer(self, arr: np.ndarray, *, codec: str = "raw") -> np.ndarray:
@@ -244,9 +360,20 @@ class TensorClient:
 
         t = threading.Thread(target=rx, daemon=True)
         t.start()
-        for a in arrays:
-            send_frame(self._sock, a, codec=codec)
-        send_end(self._sock)
+        try:
+            for a in arrays:
+                if err:
+                    break  # endpoint died: fail fast instead of pumping
+                    # sends into a full socket buffer (sendall can block
+                    # forever against a peer that stopped draining)
+                send_frame(self._sock, a, codec=codec)
+            if not err:
+                send_end(self._sock)
+        except OSError:
+            # the send side broke: prefer the rx thread's root cause
+            t.join(timeout=5.0)
+            if not err:
+                raise
         t.join(timeout=timeout_s)
         if err:
             raise err[0]
