@@ -245,27 +245,42 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
     return result
 
 
-def run_chain_overlap_row():
-    """The `chain_overlap` row: delegate to scripts/chain_overlap_smoke.py
-    (multi-process localhost chain, overlapped vs serial node loop) in a
-    subprocess so its CPU-pinned child environment never touches this
-    process's backend.  Returns the smoke's JSON row."""
+def run_script_row(script_name: str):
+    """Delegate a row to a standalone smoke script in a subprocess (its
+    CPU-pinned child environment must never touch this process's
+    backend).  Returns the script's JSON row (last stdout line)."""
     import os
     import subprocess
     script = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts", "chain_overlap_smoke.py")
+        os.path.abspath(__file__))), "scripts", script_name)
+    # pin the child to CPU explicitly: the scripts' own setdefault is a
+    # no-op when a TPU host inherits JAX_PLATFORMS/PALLAS_AXON_POOL_IPS,
+    # and the tunnel admits exactly one client (held by this process)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
     proc = subprocess.run([sys.executable, script], capture_output=True,
-                          text=True, timeout=900)
+                          text=True, timeout=900, env=env)
     if proc.returncode != 0:
         raise RuntimeError(
-            f"chain_overlap_smoke rc={proc.returncode}: "
-            f"{proc.stderr[-2000:]}")
+            f"{script_name} rc={proc.returncode}: {proc.stderr[-2000:]}")
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+#: script-delegated rows: `chain_overlap` (multi-process localhost chain,
+#: overlapped vs serial node loop) and `plan_vs_quantile` (bottleneck-
+#: solver cuts vs greedy quantile cuts, predicted + measured — the row
+#: reports how much the quantile baseline loses on the skewed chain)
+SCRIPT_ROWS = {
+    "chain_overlap": "chain_overlap_smoke.py",
+    "plan_vs_quantile": "plan_smoke.py",
+}
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default=",".join(CONFIGS) + ",chain_overlap")
+    ap.add_argument("--configs", default=",".join(CONFIGS)
+                    + "," + ",".join(SCRIPT_ROWS))
     ap.add_argument("--tiny", action="store_true",
                     help="force tiny variants (CPU smoke)")
     ap.add_argument("--full", action="store_true",
@@ -282,15 +297,15 @@ def main():
     chunk = args.chunk or (128 if jax.default_backend() == "tpu" else 16)
     for name in args.configs.split(","):
         name = name.strip()
-        if name == "chain_overlap":
+        if name in SCRIPT_ROWS:
             t0 = time.time()
             try:
-                r = run_chain_overlap_row()
+                r = run_script_row(SCRIPT_ROWS[name])
             except Exception as e:  # noqa: BLE001 — keep the suite going
                 log(f"{name}: FAILED {type(e).__name__}: {e}")
                 continue
-            log(f"{name}: {r['value']}x vs serial node loop "
-                f"({time.time() - t0:.0f}s)")
+            log(f"{name}: {r['value']}x ({r['unit']}, "
+                f"{time.time() - t0:.0f}s)")
             print(json.dumps(r), flush=True)
             continue
         if name not in CONFIGS:

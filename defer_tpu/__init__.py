@@ -18,7 +18,9 @@ Quick start::
 """
 
 from . import models
-from .graph.analysis import auto_cut_points, total_flops, valid_cut_points
+from . import plan
+from .graph.analysis import (auto_cut_points, max_activation_bytes,
+                             total_flops, valid_cut_points)
 from .graph.ir import GraphBuilder, LayerGraph, Op, ShapeSpec
 from .graph.optimize import fold_batchnorm
 from .graph.viz import summary, to_dot
@@ -57,6 +59,7 @@ __version__ = "0.1.0"
 __all__ = [
     "GraphBuilder", "LayerGraph", "Op", "ShapeSpec", "StageSpec",
     "partition", "valid_cut_points", "auto_cut_points", "total_flops",
+    "max_activation_bytes", "plan",
     "fold_batchnorm",
     "summary", "to_dot",
     "pipeline_mesh", "STAGE_AXIS", "DATA_AXIS",

@@ -8,6 +8,8 @@ deployment from one controller:
 
   models     list the model zoo
   partition  show the stage table for a model + cut spec (DOT optional)
+  plan       comm-aware bottleneck partition plan (exact solver, per-hop
+             codec selection, quantile comparison — docs/PLANNER.md)
   bench      timed-window pipeline throughput vs single-device baseline
   export     write per-stage StableHLO artifacts for a partition
   node       run one standalone stage node (recv -> stage -> relay), the
@@ -84,7 +86,66 @@ def _add_overlap_flags(p):
                         "async dispatch window)")
     p.add_argument("--sock-buf", type=int, default=0, metavar="BYTES",
                    help="SO_SNDBUF/SO_RCVBUF for every data socket "
-                        "(0 = kernel default)")
+                        "(0 = kernel default for `node`; `chain` sizes "
+                        "it to the partition's fattest boundary frame)")
+
+
+def _add_cost_flags(p):
+    """Planner cost-model knobs shared by ``plan`` and ``partition``."""
+    p.add_argument("--codecs", default="", metavar="LIST",
+                   help="comma list of candidate hop codecs "
+                        "(default: raw,lzb,bf8,bf16)")
+    p.add_argument("--link-bw", type=float, default=0.0, metavar="BYTES_S",
+                   help="hop link bandwidth in bytes/s (default: the "
+                        "detected chip generation's one-way ICI figure; "
+                        "set explicitly for DCN/ethernet hops)")
+    p.add_argument("--calibrate", action="store_true",
+                   help="micro-bench the codec table on this host "
+                        "instead of using analytic defaults")
+
+
+def _cost_model(args, graph, *, node_costs=None):
+    """Build the ``plan.StageCostModel`` the CLI flags describe."""
+    from .plan import DEFAULT_CODECS, StageCostModel, calibrate_codecs
+    names = [c for c in (args.codecs.split(",") if args.codecs
+                         else list(DEFAULT_CODECS)) if c]
+    if args.calibrate or any(n not in DEFAULT_CODECS for n in names):
+        # unknown names (bf12, ...) have no analytic row: measure them
+        codecs = calibrate_codecs(tuple(names))
+    else:
+        codecs = {n: DEFAULT_CODECS[n] for n in names}
+    return StageCostModel(graph, batch=getattr(args, "batch", 1),
+                          link_bw_s=args.link_bw or None,
+                          codecs=codecs, node_costs=node_costs)
+
+
+def _partition_json(graph, stages, plan=None) -> dict:
+    """Machine-readable partition description (``--json``) — what
+    ``scripts/plan_smoke.py`` / ``benchmarks/run.py`` parse instead of
+    scraping the human stage table."""
+    from .graph.analysis import max_activation_bytes, valid_cut_points
+    from .partition.stage import buffer_footprint
+    cuts = [s.output_name for s in stages[:-1]]
+    doc = {
+        "model": graph.name,
+        "num_stages": len(stages),
+        "cuts": cuts,
+        "valid_cut_points": valid_cut_points(graph),
+        "max_activation_bytes": max_activation_bytes(graph, cuts),
+        "stages": [{
+            "index": s.index,
+            "nodes": len(s.node_names),
+            "input": s.input_name,
+            "output": s.output_name,
+            "in_shape": list(s.in_spec.shape),
+            "out_shape": list(s.out_spec.shape),
+            "boundary_bytes": s.out_spec.size * s.out_spec.dtype.itemsize,
+        } for s in stages],
+        "buffer": buffer_footprint(stages),
+    }
+    if plan is not None:
+        doc["plan"] = plan.to_json()
+    return doc
 
 
 def cmd_models(_args):
@@ -105,22 +166,43 @@ def cmd_partition(args):
 
     graph = _get_model(args.model)
     cuts = args.cuts.split(",") if args.cuts else None
-    if cuts is not None and args.balance == "measured":
-        raise SystemExit("--cuts and --balance measured conflict: "
+    if cuts is not None and args.balance != "flops":
+        raise SystemExit(f"--cuts and --balance {args.balance} conflict: "
                          "explicit cuts leave nothing to balance")
+    if cuts is None and args.balance != "flops" and args.stages is None:
+        raise SystemExit(f"--balance {args.balance} requires --stages")
+    plan = None
     if cuts is None and args.balance == "measured":
         # latency-balanced auto-cuts: time every op on THIS backend and
         # snap quantiles of measured (not analytic) cost to valid cuts
-        if args.stages is None:
-            raise SystemExit("--balance measured requires --stages")
         from .graph.analysis import auto_cut_points
         from .utils.profiling import measured_node_costs
         params = graph.init(jax.random.key(0))
         costs = measured_node_costs(graph, params, batch=args.batch)
         cuts = auto_cut_points(graph, args.stages, costs=costs)
-        print(f"measured-balanced cuts: {cuts}")
+        if not args.json:
+            print(f"measured-balanced cuts: {cuts}")
+    elif cuts is None and args.balance == "bottleneck":
+        # comm-aware exact solver: minimize max(compute, comm) per stage
+        from .plan import solve
+        plan = solve(graph, args.stages, _cost_model(args, graph))
+        cuts = plan.cuts
+        if not args.json:
+            print(f"bottleneck cuts: {cuts} "
+                  f"(hop codecs {plan.codecs}, predicted bottleneck "
+                  f"{plan.bottleneck_s * 1e3:.4f} ms, {plan.bound_by}-"
+                  f"bound)")
     stages = partition(graph, cuts, num_stages=args.stages
                        if cuts is None else None)
+    if args.json:
+        print(json.dumps(_partition_json(graph, stages, plan)))
+        if args.dot:
+            stage_of = {name: s.index for s in stages
+                        for name in s.node_names}
+            with open(args.dot, "w") as f:
+                f.write(to_dot(graph, stage_of=stage_of))
+        del jax
+        return
     print(f"{graph.name}: {len(graph.nodes)} nodes, "
           f"{len(valid_cut_points(graph))} valid cut points")
     for s in stages:
@@ -146,6 +228,84 @@ def cmd_partition(args):
     del jax  # imported for backend side effects only
 
 
+def cmd_plan(args):
+    """Comm-aware bottleneck plan: solve, score the quantile baseline on
+    the same cost model, optionally sweep stage counts / replan from a
+    telemetry snapshot (docs/PLANNER.md)."""
+    from .graph.analysis import auto_cut_points
+    from .plan import evaluate_cuts, solve, sweep_stages
+
+    graph = _get_model(args.model)
+    node_costs = None
+    if args.measured:
+        import jax
+
+        from .utils.profiling import measured_node_costs
+        params = graph.init(jax.random.key(0))
+        node_costs = measured_node_costs(graph, params, batch=args.batch)
+    cm = _cost_model(args, graph, node_costs=node_costs)
+    doc: dict = {"model": graph.name, "cost_model": cm.describe()}
+    if args.sweep:
+        sw = sweep_stages(graph, cm, max_stages=args.sweep,
+                          latency_target_s=args.target_ms / 1e3
+                          if args.target_ms else None)
+        doc["sweep"] = [p.to_json() for p in sw["plans"]]
+        doc["target_met"] = sw["target_met"]
+        plan = sw["recommended"]
+        doc["recommended"] = plan.to_json()
+    else:
+        if args.stages is None:
+            raise SystemExit("plan requires --stages (or --sweep MAX)")
+        plan = solve(graph, args.stages, cm)
+        doc["plan"] = plan.to_json()
+    if plan.num_stages > 1:
+        # the measurable baseline: greedy quantile cuts scored on the
+        # SAME cost model the solver optimized
+        qcuts = auto_cut_points(graph, plan.num_stages, costs=node_costs)
+        qplan = evaluate_cuts(graph, qcuts, cm, objective="quantile")
+        doc["quantile"] = qplan.to_json()
+        doc["predicted_speedup_vs_quantile"] = round(
+            qplan.bottleneck_s / plan.bottleneck_s, 4) \
+            if plan.bottleneck_s > 0 else None
+    if args.replan:
+        from .plan import replan as _do_replan
+        with open(args.replan) as f:
+            snap = json.load(f)
+        rp = _do_replan(graph, plan, snap.get("registry", snap), cm)
+        doc["replan"] = rp.to_json()
+    if args.json:
+        print(json.dumps(doc))
+        return
+    print(f"{graph.name}: {plan.num_stages} stages, objective "
+          f"{plan.objective}, cost model {cm.describe()['node_costs']} "
+          f"(gen {cm.gen}, link {cm.link_bw_s:.3g} B/s)")
+    comm = plan.hop_comm_s + [0.0]
+    codecs = plan.codecs + ["-"]
+    for k, comp in enumerate(plan.stage_compute_s):
+        mark = " <- bottleneck" if k == plan.bottleneck_stage else ""
+        print(f"  stage {k}: compute {comp * 1e3:10.4f} ms | "
+              f"hop {comm[k] * 1e3:10.4f} ms ({codecs[k]}){mark}")
+    print(f"  predicted bottleneck {plan.bottleneck_s * 1e3:.4f} ms "
+          f"({plan.bound_by}-bound) -> "
+          f"{plan.predicted_throughput_per_s(cm.batch):.2f} inf/s")
+    print(f"  cuts: {','.join(plan.cuts) or '-'}")
+    if "quantile" in doc:
+        q = doc["quantile"]
+        print(f"  quantile baseline: bottleneck {q['bottleneck_ms']:.4f} "
+              f"ms at cuts {','.join(q['cuts'])} "
+              f"(speedup {doc['predicted_speedup_vs_quantile']}x)")
+    if "replan" in doc:
+        r = doc["replan"]
+        print(f"  replan: moved={r['moved']} corrections="
+              f"{r['corrections']} predicted improvement "
+              f"{r['predicted_improvement']}x")
+    if args.sweep:
+        met = doc["target_met"]
+        print(f"  sweep: recommended {plan.num_stages} stages"
+              + (f" (target {'met' if met else 'NOT met'})"
+                 if met is not None else ""))
+
+
 def cmd_bench(args):
     import jax
     import jax.numpy as jnp
@@ -156,10 +316,16 @@ def cmd_bench(args):
     graph = _get_model(args.model)
     params = graph.init(jax.random.key(0))
     cuts = args.cuts.split(",") if args.cuts else None
+    if cuts is not None and args.balance != "flops":
+        raise SystemExit(f"--cuts and --balance {args.balance} conflict: "
+                         "explicit cuts leave nothing to balance")
     if cuts is None and args.stages is None:
         # default deployment: one stage per device
         args.stages = len(jax.devices())
-    stages = partition(graph, cuts, num_stages=args.stages)
+    stages = partition(graph, cuts, num_stages=args.stages,
+                       objective="bottleneck"
+                       if cuts is None and args.balance == "bottleneck"
+                       else "quantile")
     n = len(stages)
     pipe = SpmdPipeline(
         stages, params, mesh=pipeline_mesh(n), microbatch=args.microbatch,
@@ -218,16 +384,27 @@ def cmd_export(args):
         print(p)
 
 
-def _apply_sock_buf(args):
+def _apply_sock_buf(args, *, auto_bytes: int | None = None):
     """``--sock-buf N`` sizes SO_SNDBUF/SO_RCVBUF on every data socket of
-    this process — and, via the environment, of any chain children."""
-    if getattr(args, "sock_buf", 0):
+    this process — and, via the environment, of any chain children.
+
+    ``auto_bytes`` (the partition's fattest boundary frame, from
+    ``graph.analysis.max_activation_bytes``) sizes the default when no
+    explicit ``--sock-buf`` was given: kernel buffers scale with what
+    the chain actually ships instead of a flat constant."""
+    buf = getattr(args, "sock_buf", 0)
+    if not buf and auto_bytes:
+        from .transport.framed import default_sock_buf
+        buf = default_sock_buf(auto_bytes)
+        print(f"sock-buf: auto {buf} bytes "
+              f"(2x max boundary frame {auto_bytes})", file=sys.stderr)
+    if buf:
         import os
 
         from .transport import framed
-        framed.SOCK_SNDBUF = framed.SOCK_RCVBUF = args.sock_buf
-        os.environ["DEFER_SOCK_SNDBUF"] = str(args.sock_buf)
-        os.environ["DEFER_SOCK_RCVBUF"] = str(args.sock_buf)
+        framed.SOCK_SNDBUF = framed.SOCK_RCVBUF = buf
+        os.environ["DEFER_SOCK_SNDBUF"] = str(buf)
+        os.environ["DEFER_SOCK_RCVBUF"] = str(buf)
 
 
 def cmd_node(args):
@@ -257,11 +434,21 @@ def cmd_chain(args):
     from .runtime.node import run_chain
 
     _obs_begin(args)
-    _apply_sock_buf(args)
     graph = _get_model(args.model)
     params = graph.init(jax.random.key(0))
     cuts = args.cuts.split(",") if args.cuts else None
-    stages = partition(graph, cuts, num_stages=args.stages)
+    if cuts is not None and args.balance != "flops":
+        raise SystemExit(f"--cuts and --balance {args.balance} conflict: "
+                         "explicit cuts leave nothing to balance")
+    stages = partition(graph, cuts, num_stages=args.stages,
+                       objective="bottleneck"
+                       if cuts is None and args.balance == "bottleneck"
+                       else "quantile")
+    # size every data socket's kernel buffers to the fattest boundary
+    # frame this partition ships (overridable with --sock-buf)
+    from .graph.analysis import max_activation_bytes
+    _apply_sock_buf(args, auto_bytes=max_activation_bytes(
+        graph, [s.output_name for s in stages[:-1]], batch=args.batch))
     in_spec = stages[0].in_spec
     rng = np.random.default_rng(0)
     xs = [rng.standard_normal((args.batch,) + in_spec.shape)
@@ -398,19 +585,50 @@ def main(argv=None):
     p.add_argument("--model", required=True)
     p.add_argument("--stages", type=int)
     p.add_argument("--cuts")
-    p.add_argument("--balance", choices=["flops", "measured"],
+    p.add_argument("--balance",
+                   choices=["flops", "measured", "bottleneck"],
                    default="flops",
-                   help="auto-cut cost model: analytic FLOPs, or per-op "
-                        "latency measured on this backend")
+                   help="auto-cut objective: FLOP quantiles (analytic), "
+                        "measured-latency quantiles, or the exact comm-"
+                        "aware bottleneck solver (docs/PLANNER.md)")
     p.add_argument("--batch", type=int, default=1,
-                   help="batch size for --balance measured timing")
+                   help="batch size for measured timing / comm sizing")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (cuts, stage table, "
+                        "plan predictions) instead of the human table")
     p.add_argument("--dot", help="write a DOT graph with stage coloring")
     p.add_argument("--summary", action="store_true")
+    _add_cost_flags(p)
+
+    pl = sub.add_parser("plan", help="comm-aware bottleneck partition "
+                                     "plan vs the quantile baseline")
+    pl.add_argument("--model", required=True)
+    pl.add_argument("--stages", type=int)
+    pl.add_argument("--batch", type=int, default=1,
+                    help="per-hop frame batch for the comm model")
+    pl.add_argument("--measured", action="store_true",
+                    help="measure per-node seconds on this backend "
+                         "instead of the analytic roofline")
+    pl.add_argument("--sweep", type=int, metavar="MAX",
+                    help="solve every stage count 1..MAX and recommend")
+    pl.add_argument("--target-ms", type=float, default=0.0,
+                    help="bottleneck latency target for the --sweep "
+                         "recommendation (fewest stages that meet it)")
+    pl.add_argument("--replan", metavar="METRICS_JSON",
+                    help="re-solve with measured per-stage seconds from "
+                         "a --metrics-out snapshot (telemetry-corrected "
+                         "cost model)")
+    pl.add_argument("--json", action="store_true")
+    _add_cost_flags(pl)
 
     b = sub.add_parser("bench", help="timed pipeline throughput")
     b.add_argument("--model", default="resnet_tiny")
     b.add_argument("--stages", type=int)
     b.add_argument("--cuts")
+    b.add_argument("--balance", choices=["flops", "bottleneck"],
+                   default="flops",
+                   help="auto-cut objective for --stages (bottleneck: "
+                        "the comm-aware exact solver)")
     b.add_argument("--chunk", type=int, default=16)
     b.add_argument("--microbatch", type=int, default=1)
     b.add_argument("--wire", default="buffer", choices=["buffer", "int8"])
@@ -443,6 +661,10 @@ def main(argv=None):
     c.add_argument("--model", default="resnet_tiny")
     c.add_argument("--stages", type=int, default=3)
     c.add_argument("--cuts")
+    c.add_argument("--balance", choices=["flops", "bottleneck"],
+                   default="flops",
+                   help="auto-cut objective for --stages (bottleneck: "
+                        "the comm-aware exact solver)")
     c.add_argument("--batch", type=int, default=1)
     c.add_argument("--count", type=int, default=8)
     c.add_argument("--codec", default="raw",
@@ -491,7 +713,7 @@ def main(argv=None):
     _add_obs_flags(g)
 
     args = ap.parse_args(argv)
-    {"models": cmd_models, "partition": cmd_partition,
+    {"models": cmd_models, "partition": cmd_partition, "plan": cmd_plan,
      "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
      "chain": cmd_chain, "train": cmd_train,
      "generate": cmd_generate}[args.cmd](args)

@@ -1,5 +1,6 @@
 from .ir import GraphBuilder, LayerGraph, LayerNode, Op, ShapeSpec
-from .analysis import (auto_cut_points, max_activation_elems, node_flops,
-                       total_flops, valid_cut_points)
+from .analysis import (auto_cut_points, max_activation_bytes,
+                       max_activation_elems, node_flops, total_flops,
+                       valid_cut_points)
 from .viz import summary, to_dot
 from . import ops

@@ -56,7 +56,9 @@ def total_flops(graph: LayerGraph) -> int:
 
 
 def auto_cut_points(graph: LayerGraph, num_stages: int,
-                    costs: dict[str, float] | None = None) -> list[str]:
+                    costs: dict[str, float] | None = None, *,
+                    objective: str = "quantile",
+                    cost_model=None) -> list[str]:
     """Pick ``num_stages - 1`` valid cuts balancing per-stage cost.
 
     This is the principled version of DEFER's hand-listed
@@ -69,9 +71,26 @@ def auto_cut_points(graph: LayerGraph, num_stages: int,
     hardware actually does — the FLOP model under-weights
     bandwidth-bound ops (pools, norms, cheap convs at high resolution),
     so measured balancing typically moves cuts earlier in CNNs.
+
+    ``objective="bottleneck"`` delegates to the exact comm-aware solver
+    (``defer_tpu.plan``): it minimizes ``max_k max(compute_k, comm_k)``
+    instead of compute quantiles, which matters whenever a quantile cut
+    lands on a fat activation boundary.  ``cost_model`` (a
+    ``plan.StageCostModel``) customizes hardware/codec assumptions;
+    otherwise an analytic model is built (using ``costs`` as measured
+    node seconds when given).  The quantile greedy stays the default —
+    it is the measurable baseline ``benchmarks/run.py`` compares against.
     """
     if num_stages < 1:
         raise ValueError("num_stages must be >= 1")
+    if objective == "bottleneck":
+        from ..plan import StageCostModel, solve
+        if cost_model is None:
+            cost_model = StageCostModel(graph, node_costs=costs)
+        return solve(graph, num_stages, cost_model).cuts
+    if objective != "quantile":
+        raise ValueError(f"unknown objective {objective!r}; "
+                         "use 'quantile' or 'bottleneck'")
     if num_stages == 1:
         return []
     cuts = valid_cut_points(graph)
@@ -119,3 +138,17 @@ def max_activation_elems(graph: LayerGraph, cut_points: list[str]) -> int:
     sizes = [graph.input_spec.size, graph.output_spec.size]
     sizes += [graph.out_spec(c).size for c in cut_points]
     return max(sizes)
+
+
+def max_activation_bytes(graph: LayerGraph, cut_points: list[str], *,
+                         batch: int = 1) -> int:
+    """Largest boundary tensor in BYTES (dtype itemsize included, times
+    ``batch``) — what one hop frame of a process chain actually weighs.
+    ``max_activation_elems`` undercounts mixed-dtype graphs (an int32
+    token boundary and an f32 activation boundary of equal ``size``
+    differ on the wire); this is the number that sizes kernel socket
+    buffers (``transport.framed.default_sock_buf``) and the planner's
+    comm model."""
+    specs = [graph.input_spec, graph.output_spec]
+    specs += [graph.out_spec(c) for c in cut_points]
+    return max(s.size * s.dtype.itemsize for s in specs) * max(batch, 1)

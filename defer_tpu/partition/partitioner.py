@@ -20,17 +20,31 @@ from .stage import StageSpec
 
 
 def partition(graph: LayerGraph, cut_points: list[str] | None = None,
-              *, num_stages: int | None = None) -> list[StageSpec]:
+              *, num_stages: int | None = None,
+              costs: dict[str, float] | None = None,
+              objective: str = "quantile",
+              cost_model=None) -> list[StageSpec]:
     """Split ``graph`` into ``len(cut_points)+1`` sequential stages.
 
     Either pass explicit ``cut_points`` (node names, in topological order —
     the analogue of ``partition_layers`` in reference src/dispatcher.py:107)
-    or ``num_stages`` for FLOP-balanced automatic cuts.
+    or ``num_stages`` for automatic cuts.  The automatic path forwards
+    ``costs`` (measured per-node seconds), ``objective``
+    ("quantile" greedy — the default — or the exact comm-aware
+    "bottleneck" solver) and ``cost_model`` to
+    :func:`~defer_tpu.graph.analysis.auto_cut_points`; previously
+    ``num_stages`` always fell back to the analytic-FLOP quantile
+    heuristic with no way to pass either.
     """
     if cut_points is None:
         if num_stages is None:
             raise ValueError("pass cut_points or num_stages")
-        cut_points = auto_cut_points(graph, num_stages)
+        cut_points = auto_cut_points(graph, num_stages, costs=costs,
+                                     objective=objective,
+                                     cost_model=cost_model)
+    elif costs is not None or cost_model is not None:
+        raise ValueError("explicit cut_points leave nothing to balance: "
+                         "drop costs/cost_model or drop cut_points")
 
     order = graph.topo_order
     pos = {n: i for i, n in enumerate(order)}

@@ -1,0 +1,36 @@
+"""Comm-aware pipeline planning: bottleneck-minimizing cuts, per-hop
+codec selection, telemetry-driven replanning.
+
+The quantile heuristic (``graph.analysis.auto_cut_points``) balances
+per-stage compute and ignores transport entirely; after the overlap PR
+the steady-state cost of a deployed chain is ``max_k max(compute_k,
+comm_k)``, so a cut at a fat-activation boundary can make the wire the
+bottleneck no matter how balanced the FLOPs are.  This package solves
+the real objective:
+
+* :mod:`~defer_tpu.plan.cost` — :class:`StageCostModel`: roofline (or
+  measured) per-node compute seconds + per-cut, per-codec comm seconds,
+  with host codec calibration (:func:`calibrate_codecs`).
+* :mod:`~defer_tpu.plan.solver` — exact DP (and a binary-search
+  variant) minimizing the bottleneck, choosing the cheapest codec per
+  hop, plus :func:`sweep_stages` over stage counts.
+* :mod:`~defer_tpu.plan.replan` — correct the model with a live
+  ``MetricsRegistry`` snapshot / chain ``stats`` and emit a plan diff.
+
+See ``docs/PLANNER.md`` for the model and the recurrence.
+"""
+
+from .cost import (CodecSpec, DEFAULT_CODECS, StageCostModel,
+                   bench_codec_instance, bench_codec_spec,
+                   calibrate_codecs)
+from .replan import (ReplanResult, corrected_cost_model,
+                     measured_stage_seconds, replan)
+from .solver import Plan, brute_force, evaluate_cuts, solve, sweep_stages
+
+__all__ = [
+    "CodecSpec", "DEFAULT_CODECS", "StageCostModel",
+    "bench_codec_instance", "bench_codec_spec", "calibrate_codecs",
+    "Plan", "solve", "evaluate_cuts", "sweep_stages", "brute_force",
+    "ReplanResult", "replan", "measured_stage_seconds",
+    "corrected_cost_model",
+]

@@ -1,0 +1,231 @@
+"""Stage cost model: per-node compute seconds + per-cut comm seconds.
+
+The planner's view of the hardware.  Two halves:
+
+* **Compute** — an analytic roofline per node: ``max(flops / peak,
+  bytes_moved / hbm_bw)`` with the public per-generation peaks from
+  ``utils/hw.py``.  Pass ``node_costs`` (measured seconds, e.g. from
+  ``utils.profiling.measured_node_costs``) to replace the analytic model
+  with what the backend actually does — the FLOP model under-weights
+  bandwidth-bound ops, and a CPU backend shares none of the TPU ratios.
+
+* **Comm** — per valid cut, per codec: the boundary tensor's bytes
+  (``graph.out_spec(cut)``, dtype itemsize, batch) through
+  ``encode + wire + decode``::
+
+      comm = raw/enc_Bps  +  (raw/ratio)/link_bw  +  raw/dec_Bps
+
+  Codec ratio and encode/decode throughput come from a
+  :class:`CodecSpec` table — analytic defaults below, or calibrated on
+  THIS host by :func:`calibrate_codecs` (the same measurement loop as
+  ``scripts/bench_codec.py``, on a synthetic post-ReLU-like payload).
+  Link bandwidth defaults to the chip generation's one-way ICI figure
+  (``hw.ici_bandwidth``) and is overridable (``--link-bw``) for DCN /
+  ethernet hops, where the codec trade flips in favor of compressing.
+
+The model is deliberately slack about absolute accuracy — the planner
+only needs the *relative* weights right, and ``plan/replan.py`` corrects
+the compute side with live telemetry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ..graph.ir import LayerGraph
+from ..utils import hw
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecSpec:
+    """What the comm model needs to know about one hop codec."""
+
+    name: str
+    ratio: float              #: raw bytes / wire bytes (>= 1 compresses)
+    encode_bytes_per_s: float  #: host encode throughput on RAW bytes
+    decode_bytes_per_s: float  #: host decode throughput on RAW bytes
+    lossy: bool = False
+
+    def comm_seconds(self, raw_bytes: int, link_bw: float) -> float:
+        """encode + wire + decode seconds for one boundary tensor."""
+        enc = raw_bytes / self.encode_bytes_per_s \
+            if self.encode_bytes_per_s > 0 else 0.0
+        dec = raw_bytes / self.decode_bytes_per_s \
+            if self.decode_bytes_per_s > 0 else 0.0
+        wire = (raw_bytes / max(self.ratio, 1e-9)) / link_bw \
+            if link_bw > 0 else 0.0
+        return enc + wire + dec
+
+
+#: analytic defaults (order-of-magnitude host-edge numbers; calibrate on
+#: the deployment host for real planning).  ``raw`` pays only a memcpy.
+DEFAULT_CODECS: dict[str, CodecSpec] = {
+    "raw": CodecSpec("raw", ratio=1.0, encode_bytes_per_s=8e9,
+                     decode_bytes_per_s=8e9),
+    "lzb": CodecSpec("lzb", ratio=1.3, encode_bytes_per_s=2e8,
+                     decode_bytes_per_s=5e8),
+    "bf8": CodecSpec("bf8", ratio=3.9, encode_bytes_per_s=1.5e8,
+                     decode_bytes_per_s=2.5e8, lossy=True),
+    "bf16": CodecSpec("bf16", ratio=2.0, encode_bytes_per_s=1.5e8,
+                      decode_bytes_per_s=2.5e8, lossy=True),
+}
+
+
+def bench_codec_instance(codec, payload: np.ndarray, *,
+                         reps: int = 3) -> tuple[float, float, float]:
+    """(ratio, encode_bytes_per_s, decode_bytes_per_s) for one codec
+    object on ``payload``: min over ``reps`` timed rounds after a warm
+    round — the shared measurement core of ``scripts/bench_codec.py``
+    and :func:`calibrate_codecs`."""
+    nbytes = payload.nbytes
+    enc = codec.encode(payload)  # warm (native build / first-touch)
+    t_enc = t_dec = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        enc = codec.encode(payload)
+        t_enc = min(t_enc, time.perf_counter() - t0)
+    codec.decode(enc, payload.shape, payload.dtype)  # warm
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        codec.decode(enc, payload.shape, payload.dtype)
+        t_dec = min(t_dec, time.perf_counter() - t0)
+    enc_len = enc.nbytes if isinstance(enc, memoryview) else len(enc)
+    return (nbytes / max(enc_len, 1), nbytes / max(t_enc, 1e-9),
+            nbytes / max(t_dec, 1e-9))
+
+
+def bench_codec_spec(name: str, payload: np.ndarray, *,
+                     reps: int = 3) -> CodecSpec:
+    """Measure one wire codec (by its ``transport.framed`` name) on
+    ``payload``; see :func:`bench_codec_instance`."""
+    from ..transport.framed import _codec
+    ratio, enc_bps, dec_bps = bench_codec_instance(
+        _codec(name), payload, reps=reps)
+    return CodecSpec(name=name, ratio=ratio, encode_bytes_per_s=enc_bps,
+                     decode_bytes_per_s=dec_bps,
+                     lossy=name.startswith("bf"))
+
+
+def calibrate_codecs(names=("raw", "lzb", "bf8", "bf16"), *,
+                     nbytes: int = 1 << 20, zero_fraction: float = 0.5,
+                     reps: int = 3, seed: int = 0) -> dict[str, CodecSpec]:
+    """Micro-bench every codec in ``names`` on THIS host.
+
+    The payload is a ReLU-like activation (``zero_fraction`` zeros,
+    otherwise half-normal) — the regime the hop codecs actually see, and
+    the one where lzb's ratio depends on sparsity.  ~1 MB keeps the whole
+    calibration under a second per codec even on the NumPy fallback.
+    """
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(max(nbytes // 4, 256)).astype(np.float32)
+    x[rng.random(x.size) < zero_fraction] = 0.0
+    x = np.abs(x)
+    return {n: bench_codec_spec(n, x, reps=reps) for n in names}
+
+
+class StageCostModel:
+    """Per-node compute seconds and per-cut comm seconds for a graph.
+
+    ``node_costs`` (name -> measured seconds) overrides the analytic
+    roofline; otherwise ``peak_flops_s`` / ``hbm_bw_s`` anchor it (both
+    default from the detected chip generation, falling back to v5e
+    numbers off-TPU so relative weights stay sane).  ``link_bw_s`` is the
+    hop bandwidth in bytes/s; ``codecs`` the candidate
+    :class:`CodecSpec` table per hop.
+    """
+
+    def __init__(self, graph: LayerGraph, *, batch: int = 1,
+                 gen: str | None = None,
+                 peak_flops_s: float | None = None,
+                 hbm_bw_s: float | None = None,
+                 link_bw_s: float | None = None,
+                 codecs: dict[str, CodecSpec] | None = None,
+                 node_costs: dict[str, float] | None = None,
+                 lossless_only: bool = False):
+        self.graph = graph
+        self.batch = max(int(batch), 1)
+        if gen is None:
+            gen = self._detect_gen()
+        self.gen = gen
+        # unknown generations fall back to v5e so the analytic model
+        # still ranks nodes instead of dividing by zero; absolute
+        # seconds are then only as good as the fallback (calibrate or
+        # pass node_costs for real numbers)
+        ref = gen if hw.peak_flops(gen) > 0 else "v5e"
+        self.peak_flops_s = peak_flops_s or hw.peak_flops(ref)
+        self.hbm_bw_s = hbm_bw_s or hw.hbm_bandwidth(ref)
+        self.link_bw_s = link_bw_s or hw.ici_bandwidth(ref)
+        self.codecs = dict(codecs) if codecs is not None \
+            else dict(DEFAULT_CODECS)
+        if lossless_only:
+            self.codecs = {n: c for n, c in self.codecs.items()
+                           if not c.lossy} or {"raw": DEFAULT_CODECS["raw"]}
+        if node_costs is not None:
+            missing = [n for n in graph.topo_order if n not in node_costs]
+            if missing:
+                raise ValueError(
+                    f"node_costs missing nodes: {missing[:5]}...")
+        self.node_costs = dict(node_costs) if node_costs else None
+
+    @staticmethod
+    def _detect_gen() -> str:
+        try:
+            import jax
+            return hw.identify_chip(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — no backend: analytic fallback
+            return "unknown"
+
+    # -- compute -----------------------------------------------------------
+
+    def node_seconds(self, name: str) -> float:
+        """Roofline (or measured) seconds for one node at ``batch``.
+
+        ``node_costs`` entries are taken AS-IS: measure them at the same
+        batch you plan for (``measured_node_costs(graph, params,
+        batch=...)`` does) — only the analytic roofline scales by
+        ``batch`` itself."""
+        if self.node_costs is not None:
+            return self.node_costs[name]
+        from ..graph.analysis import node_flops
+        g = self.graph
+        node = g.nodes[name]
+        flops = node_flops(g, name) * self.batch
+        moved = sum(g.out_spec(i).size * g.out_spec(i).dtype.itemsize
+                    for i in node.inputs)
+        moved += node.out_spec.size * node.out_spec.dtype.itemsize
+        moved *= self.batch
+        t_flops = flops / self.peak_flops_s if self.peak_flops_s > 0 else 0.0
+        t_mem = moved / self.hbm_bw_s if self.hbm_bw_s > 0 else 0.0
+        return max(t_flops, t_mem)
+
+    def compute_seconds(self, names) -> float:
+        return sum(self.node_seconds(n) for n in names)
+
+    # -- comm --------------------------------------------------------------
+
+    def cut_bytes(self, cut: str) -> int:
+        """Raw bytes of the boundary tensor crossing ``cut`` at ``batch``."""
+        spec = self.graph.out_spec(cut)
+        return spec.size * spec.dtype.itemsize * self.batch
+
+    def comm_seconds(self, cut: str, codec: str) -> float:
+        return self.codecs[codec].comm_seconds(self.cut_bytes(cut),
+                                               self.link_bw_s)
+
+    def best_codec(self, cut: str) -> tuple[str, float]:
+        """Cheapest (codec name, comm seconds) for the hop at ``cut``."""
+        return min(((n, self.comm_seconds(cut, n)) for n in self.codecs),
+                   key=lambda kv: kv[1])
+
+    def describe(self) -> dict:
+        return {
+            "gen": self.gen, "batch": self.batch,
+            "peak_flops_s": self.peak_flops_s, "hbm_bw_s": self.hbm_bw_s,
+            "link_bw_s": self.link_bw_s,
+            "node_costs": "measured" if self.node_costs else "roofline",
+            "codecs": {n: dataclasses.asdict(c)
+                       for n, c in self.codecs.items()},
+        }

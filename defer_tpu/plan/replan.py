@@ -1,0 +1,162 @@
+"""Telemetry-driven replanning: correct the cost model with live metrics.
+
+The planner's compute model is analytic (or one-shot measured) and will
+be wrong in ways only a running deployment can reveal — XLA fusion
+across a stage, host dispatch overhead, a slow host.  The telemetry PR
+already publishes per-stage latency histograms; this module closes the
+loop:
+
+1. :func:`measured_stage_seconds` pulls per-stage seconds out of either
+   a ``MetricsRegistry`` snapshot (``<prefix>.stage<k>.latency_s``
+   summaries from ``SpmdPipeline.stage_latencies`` /
+   ``PipelineMetrics.bind``) or a ``ChainDispatcher.stats`` reply list
+   (each node's ``infer_latency_s`` summary).
+2. :func:`replan` scales every node cost inside old stage ``k`` by
+   ``measured_k / predicted_k`` (the stage is the granularity telemetry
+   gives us), re-solves with the corrected model, and reports a plan
+   diff — so the cost model is corrected by what the chain actually did
+   instead of trusted blindly.
+
+Corrections are multiplicative and per-stage: relative node weights
+inside a stage keep the model's shape, while the stage total matches
+reality.  Stages with no samples keep factor 1.0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from ..graph.ir import LayerGraph
+from .cost import StageCostModel
+from .solver import Plan, evaluate_cuts, solve
+
+_STAGE_KEY = re.compile(r"(?:^|\.)stage(\d+)\.latency_s$")
+
+
+def measured_stage_seconds(source, *, quantile: str = "p50",
+                           scale: float = 1.0) -> dict[int, float]:
+    """stage index -> measured seconds, from telemetry.
+
+    ``source`` is either a registry snapshot dict (histogram summaries
+    under ``...stage<k>.latency_s`` keys, seconds) or a list of node
+    ``stats`` dicts (``{"stage": k, "infer_latency_s": {...}}``).
+    ``quantile`` picks the summary field (p50 by default — the
+    steady-state number; mean is skewed by compile outliers).  ``scale``
+    converts units if the source was exported scaled.
+    """
+    out: dict[int, float] = {}
+
+    def take(stage: int, summ) -> None:
+        if not isinstance(summ, dict) or not summ.get("count"):
+            return
+        v = summ.get(quantile, summ.get("mean"))
+        if v is not None:
+            out[int(stage)] = float(v) * scale
+
+    if isinstance(source, dict):
+        for key, summ in source.items():
+            m = _STAGE_KEY.search(key)
+            if m:
+                take(int(m.group(1)), summ)
+    else:  # ChainDispatcher.stats reply list
+        for row in source:
+            if isinstance(row, dict) and row.get("stage") is not None:
+                take(row["stage"], row.get("infer_latency_s"))
+    return out
+
+
+@dataclasses.dataclass
+class ReplanResult:
+    old_plan: Plan
+    #: the old cuts re-scored under the corrected model — the honest
+    #: baseline the new plan's improvement is measured against
+    old_plan_corrected: Plan
+    new_plan: Plan
+    #: per-old-stage measured/predicted factors applied to node costs
+    corrections: dict[int, float]
+    measured_stage_s: dict[int, float]
+
+    @property
+    def moved(self) -> bool:
+        return self.new_plan.cuts != self.old_plan.cuts \
+            or self.new_plan.codecs != self.old_plan.codecs
+
+    @property
+    def predicted_improvement(self) -> float:
+        """corrected-old bottleneck / new bottleneck (>1 = replan wins)."""
+        if self.new_plan.bottleneck_s <= 0:
+            return 1.0
+        return self.old_plan_corrected.bottleneck_s \
+            / self.new_plan.bottleneck_s
+
+    def to_json(self) -> dict:
+        return {
+            "moved": self.moved,
+            "predicted_improvement": round(self.predicted_improvement, 4),
+            "corrections": {k: round(v, 4)
+                            for k, v in sorted(self.corrections.items())},
+            "measured_stage_ms": {
+                k: round(v * 1e3, 4)
+                for k, v in sorted(self.measured_stage_s.items())},
+            "old": self.old_plan.to_json(),
+            "old_corrected": self.old_plan_corrected.to_json(),
+            "new": self.new_plan.to_json(),
+        }
+
+
+def corrected_cost_model(graph: LayerGraph, plan: Plan,
+                         cost: StageCostModel,
+                         measured: dict[int, float]) -> StageCostModel:
+    """``cost`` with node seconds rescaled so each old stage's total
+    matches its measured seconds (unmeasured stages keep factor 1)."""
+    order = graph.topo_order
+    pos = {n: i for i, n in enumerate(order)}
+    bounds = [0] + [pos[c] + 1 for c in plan.cuts] + [len(order)]
+    node_costs: dict[str, float] = {}
+    for k in range(len(bounds) - 1):
+        names = order[bounds[k]:bounds[k + 1]]
+        predicted = cost.compute_seconds(names)
+        factor = 1.0
+        if k in measured and predicted > 0:
+            factor = measured[k] / predicted
+        for n in names:
+            # node_seconds is already at the model's batch; node_costs
+            # entries are consumed as-is, so no batch rescaling here
+            node_costs[n] = cost.node_seconds(n) * factor
+    return StageCostModel(
+        graph, batch=cost.batch, gen=cost.gen,
+        peak_flops_s=cost.peak_flops_s, hbm_bw_s=cost.hbm_bw_s,
+        link_bw_s=cost.link_bw_s, codecs=cost.codecs,
+        node_costs=node_costs)
+
+
+def replan(graph: LayerGraph, plan: Plan, source,
+           cost: StageCostModel | None = None, *,
+           quantile: str = "p50") -> ReplanResult:
+    """Re-solve ``plan`` with telemetry-corrected stage costs.
+
+    ``source`` is a registry snapshot or node-stats list (see
+    :func:`measured_stage_seconds`).  ``cost`` defaults to a fresh
+    analytic model matching the plan's stage count assumptions — pass
+    the model the plan was built with when available.
+    """
+    if cost is None:
+        cost = StageCostModel(graph)
+    measured = measured_stage_seconds(source, quantile=quantile)
+    corrected = corrected_cost_model(graph, plan, cost, measured)
+    order = graph.topo_order
+    pos = {n: i for i, n in enumerate(order)}
+    bounds = [0] + [pos[c] + 1 for c in plan.cuts] + [len(order)]
+    corrections = {}
+    for k in range(len(bounds) - 1):
+        names = order[bounds[k]:bounds[k + 1]]
+        pred = cost.compute_seconds(names)
+        corrections[k] = (measured[k] / pred
+                          if k in measured and pred > 0 else 1.0)
+    old_corrected = evaluate_cuts(graph, plan.cuts, corrected,
+                                  objective=plan.objective)
+    new_plan = solve(graph, plan.num_stages, corrected)
+    return ReplanResult(old_plan=plan, old_plan_corrected=old_corrected,
+                        new_plan=new_plan, corrections=corrections,
+                        measured_stage_s=measured)

@@ -1,0 +1,335 @@
+"""Exact bottleneck-minimizing partition planner over the valid-cut chain.
+
+Pipeline throughput at steady state is ``1 / max_k max(compute_k,
+comm_k)`` — the slowest of every stage's compute and every hop's
+transport ("The TensorFlow Partitioning and Scheduling Problem: It's the
+Critical Path!", PAPERS.md, makes the general form of this argument).
+The greedy quantile heuristic in ``graph.analysis.auto_cut_points``
+balances cumulative *compute* only; this module minimizes the true
+bottleneck exactly:
+
+* ``solve`` — O(C^2 * S) dynamic program over the C valid cuts:
+
+      dp[s][i] = min over j < i of
+                 max(dp[s-1][j], compute(j..i), comm(i))
+
+  where ``compute(j..i)`` is the prefix-sum difference of per-node
+  seconds and ``comm(i)`` is the *cheapest-codec* transport time at cut
+  ``i`` (codec choice is separable: each hop's codec affects only that
+  hop's term of the max, so the per-hop argmin is globally optimal).
+
+* ``solve(method="bisect")`` — binary search over the O(C^2) candidate
+  bottleneck values with a greedy O(C) feasibility check (place each cut
+  as far right as the limit allows).  Same optimum, near-linear per
+  probe; cross-checked against the DP in tests.
+
+The final relay back to the dispatcher (SPMD wrap hop / chain result
+hop) is cut-independent — the output tensor is fixed — so it is reported
+on the plan but excluded from the objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..graph.analysis import valid_cut_points
+from ..graph.ir import LayerGraph
+from .cost import StageCostModel
+
+
+@dataclasses.dataclass
+class Plan:
+    """A solved (or evaluated) pipeline partition with its predictions."""
+
+    graph_name: str
+    num_stages: int
+    cuts: list[str]
+    codecs: list[str]              #: per hop, len == len(cuts)
+    stage_compute_s: list[float]   #: len == num_stages
+    hop_comm_s: list[float]        #: len == len(cuts)
+    bottleneck_s: float
+    objective: str
+    cost: dict                     #: StageCostModel.describe()
+
+    @property
+    def stage_cost_s(self) -> list[float]:
+        """Per-stage steady-state cost: max(compute_k, comm_k)."""
+        return [max(c, self.hop_comm_s[k]) if k < len(self.hop_comm_s)
+                else c for k, c in enumerate(self.stage_compute_s)]
+
+    @property
+    def bottleneck_stage(self) -> int:
+        costs = self.stage_cost_s
+        return costs.index(max(costs)) if costs else 0
+
+    @property
+    def bound_by(self) -> str:
+        """"compute" or "comm" — which side of the max binds."""
+        k = self.bottleneck_stage
+        if k < len(self.hop_comm_s) and \
+                self.hop_comm_s[k] > self.stage_compute_s[k]:
+            return "comm"
+        return "compute"
+
+    def predicted_throughput_per_s(self, batch: int = 1) -> float:
+        return batch / self.bottleneck_s if self.bottleneck_s > 0 else 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "graph": self.graph_name,
+            "objective": self.objective,
+            "num_stages": self.num_stages,
+            "cuts": list(self.cuts),
+            "hop_codecs": list(self.codecs),
+            "stage_compute_ms": [round(s * 1e3, 6)
+                                 for s in self.stage_compute_s],
+            "hop_comm_ms": [round(s * 1e3, 6) for s in self.hop_comm_s],
+            "stage_cost_ms": [round(s * 1e3, 6) for s in self.stage_cost_s],
+            "bottleneck_ms": round(self.bottleneck_s * 1e3, 6),
+            "bottleneck_stage": self.bottleneck_stage,
+            "bound_by": self.bound_by,
+            "cost_model": self.cost,
+        }
+
+
+def _tables(graph: LayerGraph, cost: StageCostModel):
+    """(cuts, cum compute prefix at each cut, total compute, per-cut
+    (comm seconds, codec)) shared by every solver path."""
+    cuts = valid_cut_points(graph)
+    order = graph.topo_order
+    node_s = {n: cost.node_seconds(n) for n in order}
+    acc = 0.0
+    cum_at = {}
+    for n in order:
+        acc += node_s[n]
+        cum_at[n] = acc
+    total = acc
+    cum = [cum_at[c] for c in cuts]
+    comm = []
+    for c in cuts:
+        name, s = cost.best_codec(c)
+        comm.append((s, name))
+    return cuts, cum, total, comm
+
+
+def _mk_plan(graph, cost, chosen_idx, cuts, cum, total, comm,
+             objective: str) -> Plan:
+    bounds = [0.0] + [cum[i] for i in chosen_idx] + [total]
+    stage_compute = [bounds[k + 1] - bounds[k]
+                     for k in range(len(chosen_idx) + 1)]
+    hop_comm = [comm[i][0] for i in chosen_idx]
+    codecs = [comm[i][1] for i in chosen_idx]
+    bottleneck = max([max(c, hop_comm[k]) if k < len(hop_comm) else c
+                      for k, c in enumerate(stage_compute)] or [0.0])
+    return Plan(graph_name=graph.name, num_stages=len(chosen_idx) + 1,
+                cuts=[cuts[i] for i in chosen_idx], codecs=codecs,
+                stage_compute_s=stage_compute, hop_comm_s=hop_comm,
+                bottleneck_s=bottleneck, objective=objective,
+                cost=cost.describe())
+
+
+def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
+                  cost: StageCostModel, *,
+                  objective: str = "explicit") -> Plan:
+    """Predictions for an *explicit* cut list under ``cost`` (cheapest
+    codec per hop) — how quantile or hand-picked cuts score on the same
+    model the solver optimizes."""
+    cuts, cum, total, comm = _tables(graph, cost)
+    pos = {c: i for i, c in enumerate(cuts)}
+    missing = [c for c in cut_points if c not in pos]
+    if missing:
+        raise ValueError(f"not valid cut points: {missing}")
+    return _mk_plan(graph, cost, [pos[c] for c in cut_points],
+                    cuts, cum, total, comm, objective)
+
+
+def solve(graph: LayerGraph, num_stages: int, cost: StageCostModel, *,
+          method: str = "dp") -> Plan:
+    """Optimal bottleneck plan for exactly ``num_stages`` stages."""
+    if num_stages < 1:
+        raise ValueError("num_stages must be >= 1")
+    cuts, cum, total, comm = _tables(graph, cost)
+    C = len(cuts)
+    if C < num_stages - 1:
+        raise ValueError(
+            f"graph {graph.name!r} has only {C} valid cut points; "
+            f"cannot make {num_stages} stages")
+    if num_stages == 1:
+        return _mk_plan(graph, cost, [], cuts, cum, total, comm,
+                        "bottleneck")
+    if method == "bisect":
+        chosen = _solve_bisect(cum, total, [c[0] for c in comm],
+                               num_stages)
+    elif method == "dp":
+        chosen = _solve_dp(cum, total, [c[0] for c in comm], num_stages)
+    else:
+        raise ValueError(f"unknown method {method!r}")
+    return _mk_plan(graph, cost, chosen, cuts, cum, total, comm,
+                    "bottleneck")
+
+
+def _solve_dp(cum: list[float], total: float, comm: list[float],
+              S: int) -> list[int]:
+    """O(C^2 * S) DP; returns the chosen cut indices (len S-1)."""
+    C = len(cum)
+    INF = float("inf")
+    # dp[i]: cut i is the s-th cut; parent[s][i]: the (s-1)-th cut's index
+    dp = [INF] * C
+    parent: list[list[int]] = []
+    for i in range(C):
+        # the s=1 row; cut i must leave >= S-2 cuts after it
+        if C - 1 - i >= S - 2:
+            dp[i] = max(cum[i], comm[i])
+    parent.append([-1] * C)
+    for s in range(2, S):
+        nxt = [INF] * C
+        par = [-1] * C
+        for i in range(s - 1, C):
+            if C - 1 - i < S - 1 - s:
+                continue  # not enough cuts left for the later stages
+            best, arg = INF, -1
+            for j in range(s - 2, i):
+                if dp[j] == INF:
+                    continue
+                v = max(dp[j], cum[i] - cum[j], comm[i])
+                if v < best:
+                    best, arg = v, j
+            nxt[i], par[i] = best, arg
+        dp, parent = nxt, parent + [par]
+    best, last = INF, -1
+    for i in range(S - 2, C):
+        if dp[i] == INF:
+            continue
+        v = max(dp[i], total - cum[i])
+        if v < best:
+            best, last = v, i
+    if last < 0:
+        raise ValueError("no feasible plan (internal)")
+    chosen = [last]
+    for s in range(S - 2, 0, -1):
+        chosen.append(parent[s][chosen[-1]])
+    return chosen[::-1]
+
+
+def _greedy_feasible(cum: list[float], total: float, comm: list[float],
+                     S: int, limit: float) -> list[int] | None:
+    """Cut indices (exactly S-1) achieving bottleneck <= limit, or None.
+
+    With per-cut comm eligibility, naive farthest-cut greedy can strand
+    the later stages on ineligible cuts, so the check is structural:
+
+    * eligible cuts ``E`` = comm <= limit; any solution's cuts are a
+      subset of ``E``, so if cutting at ALL of ``E`` still leaves a
+      segment > limit, no subset can fix it -> infeasible;
+    * the classic farthest-eligible greedy gives the MINIMAL cut count
+      ``m``; using all of ``E`` gives the maximal; and adding any unused
+      eligible cut to a valid solution keeps it valid (splitting only
+      shrinks segments), so every count in ``[m, len(E)]`` is achievable
+      -> feasible iff ``m <= S-1 <= len(E)``, padding the greedy
+      solution with unused eligible cuts up to exactly S-1.
+    """
+    eps = 1e-12 + limit * 1e-9  # float-sum slack: DP and greedy add in
+    #   different orders, so exact equality at the optimum must pass
+    E = [i for i in range(len(cum)) if comm[i] <= limit + eps]
+    if len(E) < S - 1:
+        return None
+    prev = 0.0
+    for i in E:  # the finest available partition must itself fit
+        if cum[i] - prev > limit + eps:
+            return None
+        prev = cum[i]
+    if total - prev > limit + eps:
+        return None
+    chosen: list[int] = []
+    prev_cum = 0.0
+    idx = 0
+    while total - prev_cum > limit + eps:
+        pick = -1
+        while idx < len(E) and cum[E[idx]] - prev_cum <= limit + eps:
+            pick = E[idx]
+            idx += 1
+        if pick < 0:
+            return None  # unreachable after the gap check; belt+braces
+        chosen.append(pick)
+        prev_cum = cum[pick]
+    if len(chosen) > S - 1:
+        return None  # needs more stages than allowed
+    if len(chosen) < S - 1:  # pad with unused eligible cuts
+        used = set(chosen)
+        for i in E:
+            if len(chosen) == S - 1:
+                break
+            if i not in used:
+                chosen.append(i)
+        chosen.sort()
+    return chosen
+
+
+def _solve_bisect(cum: list[float], total: float, comm: list[float],
+                  S: int) -> list[int]:
+    """Binary search over candidate bottleneck values + greedy check."""
+    cands = set(comm)
+    pts = [0.0] + cum
+    for i, ci in enumerate(cum):
+        for p in pts[: i + 1]:
+            cands.add(ci - p)
+    cands.update(total - c for c in cum)
+    cands.add(total)
+    ordered = sorted(c for c in cands if c >= 0.0)
+    lo, hi = 0, len(ordered) - 1
+    best: list[int] | None = None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        got = _greedy_feasible(cum, total, comm, S, ordered[mid])
+        if got is not None:
+            best = got
+            hi = mid - 1
+        else:
+            lo = mid + 1
+    if best is None:
+        raise ValueError("no feasible plan (internal)")
+    return best
+
+
+def sweep_stages(graph: LayerGraph, cost: StageCostModel, *,
+                 max_stages: int | None = None,
+                 latency_target_s: float | None = None) -> dict:
+    """Solve for every stage count 1..max and pick a recommendation.
+
+    Without a target: the stage count minimizing the bottleneck (ties to
+    the fewest chips).  With ``latency_target_s``: the FEWEST stages
+    whose bottleneck meets the target (chips are the scarce resource),
+    falling back to the overall best when nothing meets it.
+    """
+    C = len(valid_cut_points(graph))
+    hi = C + 1 if max_stages is None else min(max_stages, C + 1)
+    plans = [solve(graph, n, cost) for n in range(1, hi + 1)]
+    pick = min(plans, key=lambda p: (p.bottleneck_s, p.num_stages))
+    met = None
+    if latency_target_s is not None:
+        feasible = [p for p in plans if p.bottleneck_s <= latency_target_s]
+        if feasible:
+            pick = min(feasible, key=lambda p: p.num_stages)
+            met = True
+        else:
+            met = False
+    return {"plans": plans, "recommended": pick,
+            "latency_target_s": latency_target_s, "target_met": met}
+
+
+def brute_force(graph: LayerGraph, num_stages: int,
+                cost: StageCostModel) -> Plan:
+    """Exhaustive reference solver (test oracle; exponential — keep the
+    graph under ~12 valid cuts)."""
+    import itertools
+    cuts, cum, total, comm = _tables(graph, cost)
+    if len(cuts) < num_stages - 1:
+        raise ValueError("not enough cuts")
+    best_plan = None
+    for combo in itertools.combinations(range(len(cuts)), num_stages - 1):
+        p = _mk_plan(graph, cost, list(combo), cuts, cum, total, comm,
+                     "brute_force")
+        if best_plan is None or p.bottleneck_s < best_plan.bottleneck_s:
+            best_plan = p
+    assert best_plan is not None
+    return best_plan

@@ -18,7 +18,6 @@ One JSON line on stdout; CPU-only (the host edge is where these run).
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -75,32 +74,30 @@ def main():
 
 
 def _bench_codecs(codecs, payload, rows):
+    # timing core shared with the planner's codec calibration
+    # (defer_tpu.plan.cost.calibrate_codecs uses the same loop)
+    from defer_tpu.plan.cost import bench_codec_instance
+
     nbytes = payload.nbytes
     for c in codecs:
         name = c.name + (f"{c.bits}" if hasattr(c, "bits") else "")
-        enc = c.encode(payload)  # warm
         reps = max(3, int(50e6 // max(nbytes, 1)))
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            enc = c.encode(payload)
-        t_enc = (time.perf_counter() - t0) / reps
+        ratio, enc_bps, dec_bps = bench_codec_instance(c, payload,
+                                                       reps=reps)
+        enc = c.encode(payload)
         dec = c.decode(enc, payload.shape, payload.dtype)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            dec = c.decode(enc, payload.shape, payload.dtype)
-        t_dec = (time.perf_counter() - t0) / reps
         err = float(np.max(np.abs(dec.astype(np.float64)
                                   - payload.astype(np.float64))))
         scale = float(np.max(np.abs(payload))) or 1.0
         rows[name] = {
-            "ratio": round(nbytes / len(enc), 3),
-            "encode_mb_s": round(nbytes / 1e6 / t_enc, 1),
-            "decode_mb_s": round(nbytes / 1e6 / t_dec, 1),
+            "ratio": round(ratio, 3),
+            "encode_mb_s": round(enc_bps / 1e6, 1),
+            "decode_mb_s": round(dec_bps / 1e6, 1),
             "max_rel_err": round(err / scale, 6),
         }
-        print(f"{name:16s} ratio {nbytes / len(enc):6.2f}x  "
-              f"enc {nbytes / 1e6 / t_enc:8.1f} MB/s  "
-              f"dec {nbytes / 1e6 / t_dec:8.1f} MB/s  "
+        print(f"{name:16s} ratio {ratio:6.2f}x  "
+              f"enc {enc_bps / 1e6:8.1f} MB/s  "
+              f"dec {dec_bps / 1e6:8.1f} MB/s  "
               f"rel err {err / scale:.2e}", file=sys.stderr, flush=True)
 
 

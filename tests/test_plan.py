@@ -1,0 +1,338 @@
+"""Planner tests: DP optimality vs brute force, bisect agreement, codec
+selection, telemetry replan, and the CLI's machine-readable surface."""
+
+import json
+import random
+
+import pytest
+
+from defer_tpu import GraphBuilder, partition
+from defer_tpu.graph import ops
+from defer_tpu.graph.analysis import (auto_cut_points, max_activation_bytes,
+                                      max_activation_elems,
+                                      valid_cut_points)
+from defer_tpu.plan import (CodecSpec, StageCostModel, brute_force,
+                            evaluate_cuts, measured_stage_seconds, replan,
+                            solve, sweep_stages)
+
+
+def dense_chain(widths, name="chain", in_width=8):
+    b = GraphBuilder(name)
+    x = b.input((in_width,))
+    for i, w in enumerate(widths):
+        x = b.add(ops.Dense(w), x, name=f"fc{i}")
+    return b.build()
+
+
+def random_graph(rng: random.Random, idx: int):
+    """Random chain with occasional diamonds (invalid interior cuts), at
+    most ~12 valid cut points."""
+    b = GraphBuilder(f"rand{idx}")
+    x = b.input((rng.choice([2, 4, 8, 16]),))
+    n = rng.randint(3, 9)
+    for i in range(n):
+        w = rng.choice([2, 4, 8, 32, 128])
+        if rng.random() < 0.25:
+            l = b.add(ops.Dense(w), x, name=f"l{i}")
+            r = b.add(ops.Dense(w), x, name=f"r{i}")
+            x = b.add(ops.Add(), [l, r], name=f"m{i}")
+        else:
+            x = b.add(ops.Dense(w), x, name=f"d{i}")
+    return b.build()
+
+
+# -- solver optimality -------------------------------------------------------
+
+
+def test_dp_matches_brute_force_property():
+    """The DP must equal exhaustive enumeration's bottleneck on every
+    random small graph, for every feasible stage count — and the binary-
+    search variant must agree with both."""
+    rng = random.Random(7)
+    checked = 0
+    for t in range(14):
+        g = random_graph(rng, t)
+        C = len(valid_cut_points(g))
+        if C == 0:
+            continue
+        cm = StageCostModel(
+            g, batch=rng.choice([1, 4]), gen="v4",
+            link_bw_s=rng.choice([1e5, 1e7, 1e9]))
+        for S in range(2, min(C + 1, 5) + 1):
+            p_dp = solve(g, S, cm)
+            p_bi = solve(g, S, cm, method="bisect")
+            p_bf = brute_force(g, S, cm)
+            tol = 1e-12 + 1e-6 * p_bf.bottleneck_s
+            assert abs(p_dp.bottleneck_s - p_bf.bottleneck_s) <= tol, \
+                (t, S, p_dp.bottleneck_s, p_bf.bottleneck_s)
+            assert abs(p_bi.bottleneck_s - p_bf.bottleneck_s) <= tol, \
+                (t, S, p_bi.bottleneck_s, p_bf.bottleneck_s)
+            assert len(p_dp.cuts) == S - 1
+            assert len(p_dp.codecs) == S - 1
+            checked += 1
+    assert checked >= 20  # the property actually exercised many cases
+
+
+def test_solver_beats_or_matches_quantile_on_same_model():
+    g = dense_chain([16, 64, 16, 64, 16, 64, 16])
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e6)
+    for S in (2, 3, 4):
+        plan = solve(g, S, cm)
+        q = evaluate_cuts(g, auto_cut_points(g, S), cm)
+        assert plan.bottleneck_s <= q.bottleneck_s * (1 + 1e-9)
+
+
+def test_solver_avoids_fat_boundary():
+    """The quantile heuristic's worst case: FLOP midpoint on a fat
+    activation.  The solver must cut at the thin boundary instead."""
+    g = dense_chain([4096, 16, 16], in_width=16)  # fc0 out = 4096 elems
+    q = auto_cut_points(g, 2)
+    assert q == ["fc0"]  # FLOP-balanced cut lands on the fat boundary
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e6)  # slow link
+    plan = solve(g, 2, cm)
+    assert plan.cuts == ["fc1"]
+    assert plan.bottleneck_s < evaluate_cuts(g, q, cm).bottleneck_s
+    # and auto_cut_points delegates to the same answer
+    assert auto_cut_points(g, 2, objective="bottleneck",
+                           cost_model=cm) == ["fc1"]
+
+
+def test_solver_errors():
+    g = dense_chain([8, 8])
+    cm = StageCostModel(g, gen="v4")
+    with pytest.raises(ValueError, match="valid cut points"):
+        solve(g, 50, cm)
+    with pytest.raises(ValueError, match="num_stages"):
+        solve(g, 0, cm)
+    with pytest.raises(ValueError, match="objective"):
+        auto_cut_points(g, 2, objective="nope")
+    assert solve(g, 1, cm).cuts == []
+
+
+# -- codec selection ---------------------------------------------------------
+
+
+def _codec_table():
+    return {
+        "raw": CodecSpec("raw", 1.0, 8e9, 8e9),
+        "bf8": CodecSpec("bf8", 4.0, 2e8, 4e8, lossy=True),
+    }
+
+
+def test_per_hop_codec_selection_follows_link_bandwidth():
+    g = dense_chain([4096, 16, 16], in_width=16)
+    # slow link: shipping 4x fewer bytes beats the encode cost
+    slow = StageCostModel(g, gen="v4", link_bw_s=1e6,
+                          codecs=_codec_table())
+    assert slow.best_codec("fc0")[0] == "bf8"
+    # ICI-class link: the wire is nearly free, encode time dominates
+    fast = StageCostModel(g, gen="v4", link_bw_s=4.5e10,
+                          codecs=_codec_table())
+    assert fast.best_codec("fc0")[0] == "raw"
+    # lossless_only drops the blockfloat candidates entirely
+    lossless = StageCostModel(g, gen="v4", link_bw_s=1e6,
+                              codecs=_codec_table(), lossless_only=True)
+    assert "bf8" not in lossless.codecs
+
+
+def test_plan_json_shape():
+    g = dense_chain([16, 16, 16])
+    plan = solve(g, 2, StageCostModel(g, gen="v4"))
+    d = plan.to_json()
+    assert d["num_stages"] == 2 and len(d["cuts"]) == 1
+    assert len(d["hop_codecs"]) == 1
+    assert len(d["stage_compute_ms"]) == 2 and len(d["hop_comm_ms"]) == 1
+    assert d["bound_by"] in ("compute", "comm")
+    json.dumps(d)  # JSON-serializable end to end
+
+
+def test_sweep_stages_recommendation():
+    g = dense_chain([16, 16, 16, 16, 16, 16])
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e9)
+    sw = sweep_stages(g, cm, max_stages=4)
+    assert [p.num_stages for p in sw["plans"]] == [1, 2, 3, 4]
+    # unmeetable target: fall back to the best plan, target_met False
+    sw2 = sweep_stages(g, cm, max_stages=4, latency_target_s=1e-30)
+    assert sw2["target_met"] is False
+    # trivially-met target: recommend the FEWEST stages
+    sw3 = sweep_stages(g, cm, max_stages=4, latency_target_s=1e6)
+    assert sw3["target_met"] is True
+    assert sw3["recommended"].num_stages == 1
+
+
+# -- quantile greedy regressions ---------------------------------------------
+
+
+def test_quantile_tail_pool_guard_skewed_costs():
+    """Skewed measured costs push every quantile target to the curve's
+    tail; the greedy pick must still leave enough candidates for the
+    later cuts instead of exhausting the pool (regression for the
+    restricted-candidate guard in auto_cut_points)."""
+    g = dense_chain([16] * 10)
+    order = g.topo_order
+    # virtually all measured cost on the last node: every target sits
+    # at the tail of the cumulative curve
+    costs = {n: 1e-6 for n in order}
+    costs[order[-1]] = 1e3
+    for S in (3, 4, 5, 6):
+        cuts = auto_cut_points(g, S, costs=costs)
+        assert len(cuts) == S - 1
+        idx = [order.index(c) for c in cuts]
+        assert idx == sorted(idx) and len(set(idx)) == len(idx)
+    # same shape through the partitioner's new costs= path
+    stages = partition(g, num_stages=4, costs=costs)
+    assert len(stages) == 4
+    with pytest.raises(ValueError, match="nothing to balance"):
+        partition(g, ["fc0"], costs=costs)
+
+
+# -- boundary bytes / sock-buf sizing ----------------------------------------
+
+
+def test_max_activation_bytes():
+    g = dense_chain([4096, 16], in_width=16)
+    cuts = ["fc0"]
+    elems = max_activation_elems(g, cuts)
+    assert elems == 4096
+    assert max_activation_bytes(g, cuts) == 4096 * 4  # f32 itemsize
+    assert max_activation_bytes(g, cuts, batch=8) == 4096 * 4 * 8
+    # thin cut only: the graph input/output still bound the answer
+    assert max_activation_bytes(g, ["fc1"]) == 16 * 4
+
+
+def test_default_sock_buf_clamps():
+    from defer_tpu.transport.framed import default_sock_buf
+    assert default_sock_buf(100) == 1 << 16            # floor
+    assert default_sock_buf(1 << 20) == 2 << 20        # 2x frame
+    assert default_sock_buf(1 << 30) == 1 << 23        # ceil
+
+
+# -- telemetry replan --------------------------------------------------------
+
+
+def test_measured_stage_seconds_both_sources():
+    snap = {
+        "pipeline0.stage0.latency_s": {"count": 9, "p50": 0.02,
+                                       "mean": 0.05},
+        "pipeline0.stage1.latency_s": {"count": 9, "p50": 0.004},
+        "pipeline0.stage2.latency_s": {"count": 0},   # empty: skipped
+        "pipeline0.push_latency_s": {"count": 9, "p50": 1.0},  # not a stage
+        "transport.tx_bytes": 123,
+    }
+    got = measured_stage_seconds(snap)
+    assert got == {0: 0.02, 1: 0.004}
+    assert measured_stage_seconds(snap, quantile="mean")[0] == 0.05
+    stats = [{"stage": 1, "infer_latency_s": {"count": 4, "p50": 0.5}},
+             {"stage": None, "infer_latency_s": {"count": 4, "p50": 9.0}},
+             {"stage": 0, "infer_latency_s": {"count": 0}}]
+    assert measured_stage_seconds(stats) == {1: 0.5}
+
+
+def test_replan_moves_cut_toward_measured_hotspot():
+    """Telemetry says stage 0 is 10x slower than the model predicted:
+    the replan must (a) scale stage-0 node costs up, (b) move the cut
+    earlier, (c) predict an improvement over keeping the old cuts."""
+    # near-free transport so compute (not the equal-size hops) decides
+    # cuts: the hop codec's modeled memcpy would otherwise dominate the
+    # nanosecond-scale roofline of a toy dense chain
+    g = dense_chain([512] * 8, in_width=512)
+    free = {"raw": CodecSpec("raw", 1.0, 1e15, 1e15)}
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e13, codecs=free)
+    plan = solve(g, 2, cm)
+    assert plan.cuts == ["fc3"]  # balanced 4/4 before telemetry lands
+    pred0 = cm.compute_seconds(
+        g.topo_order[: g.topo_order.index(plan.cuts[0]) + 1])
+    snap = {
+        "pipeline0.stage0.latency_s": {"count": 20, "p50": pred0 * 10},
+        # stage 1 measured exactly as predicted
+        "pipeline0.stage1.latency_s": {
+            "count": 20,
+            "p50": cm.compute_seconds(
+                g.topo_order[g.topo_order.index(plan.cuts[0]) + 1:])},
+    }
+    rp = replan(g, plan, snap, cm)
+    assert rp.corrections[0] == pytest.approx(10.0, rel=1e-6)
+    assert rp.corrections[1] == pytest.approx(1.0, rel=1e-6)
+    assert rp.moved
+    order = g.topo_order
+    assert order.index(rp.new_plan.cuts[0]) < order.index(plan.cuts[0])
+    assert rp.predicted_improvement > 1.0
+    d = rp.to_json()
+    json.dumps(d)
+    assert d["moved"] is True
+
+
+def test_replan_noop_when_model_is_right():
+    g = dense_chain([16] * 6)
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e9)
+    plan = solve(g, 3, cm)
+    order = g.topo_order
+    bounds = [0] + [order.index(c) + 1 for c in plan.cuts] + [len(order)]
+    snap = {}
+    for k in range(3):
+        names = order[bounds[k]:bounds[k + 1]]
+        snap[f"p.stage{k}.latency_s"] = {
+            "count": 5, "p50": cm.compute_seconds(names)}
+    rp = replan(g, plan, snap, cm)
+    assert all(v == pytest.approx(1.0, rel=1e-6)
+               for v in rp.corrections.values())
+    assert rp.new_plan.bottleneck_s == pytest.approx(
+        plan.bottleneck_s, rel=1e-6)
+
+
+# -- CLI machine-readable surface --------------------------------------------
+
+
+def test_cli_partition_json(capsys):
+    from defer_tpu.cli import main
+    main(["partition", "--model", "resnet_tiny", "--stages", "3",
+          "--json"])
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["model"] == "resnet_tiny" and d["num_stages"] == 3
+    assert len(d["cuts"]) == 2 and len(d["stages"]) == 3
+    assert d["max_activation_bytes"] > 0
+    assert "buffer" in d and "plan" not in d
+    for s in d["stages"]:
+        assert {"index", "nodes", "in_shape", "out_shape",
+                "boundary_bytes"} <= set(s)
+
+
+def test_cli_partition_json_bottleneck(capsys):
+    from defer_tpu.cli import main
+    main(["partition", "--model", "resnet_tiny", "--stages", "3",
+          "--balance", "bottleneck", "--link-bw", "1e8", "--json"])
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["cuts"] == d["plan"]["cuts"]
+    assert len(d["plan"]["hop_codecs"]) == 2
+    assert d["plan"]["bottleneck_ms"] > 0
+
+
+def test_cli_plan_json(capsys, tmp_path):
+    from defer_tpu.cli import main
+    main(["plan", "--model", "resnet_tiny", "--stages", "3",
+          "--link-bw", "1e8", "--json"])
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["plan"]["num_stages"] == 3
+    assert d["quantile"]["objective"] == "quantile"
+    assert d["predicted_speedup_vs_quantile"] >= 1.0
+    # replan flow: feed a fabricated --metrics-out style snapshot back in
+    snap = {"registry": {
+        "pipeline0.stage0.latency_s": {"count": 10, "p50": 0.5},
+        "pipeline0.stage1.latency_s": {"count": 10, "p50": 0.001},
+        "pipeline0.stage2.latency_s": {"count": 10, "p50": 0.001},
+    }}
+    f = tmp_path / "metrics.json"
+    f.write_text(json.dumps(snap))
+    main(["plan", "--model", "resnet_tiny", "--stages", "3",
+          "--link-bw", "1e8", "--replan", str(f), "--json"])
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["replan"]["corrections"]["0"] > 1.0
+    assert d["replan"]["new"]["num_stages"] == 3
+
+
+def test_cli_plan_sweep_json(capsys):
+    from defer_tpu.cli import main
+    main(["plan", "--model", "resnet_tiny", "--sweep", "3", "--json"])
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [p["num_stages"] for p in d["sweep"]] == [1, 2, 3]
+    assert d["recommended"]["num_stages"] in (1, 2, 3)
