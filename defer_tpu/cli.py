@@ -245,7 +245,21 @@ def cmd_plan(args):
         node_costs = measured_node_costs(graph, params, batch=args.batch)
     cm = _cost_model(args, graph, node_costs=node_costs)
     doc: dict = {"model": graph.name, "cost_model": cm.describe()}
-    if args.sweep:
+    if args.nodes:
+        # hybrid pipeline/data-parallel: joint cuts + replica counts for
+        # a process budget, vs the best cuts-only plan it must beat
+        from .plan import solve_replicated
+        plan = solve_replicated(graph, cm, num_nodes=args.nodes)
+        doc["plan"] = plan.to_json()
+        from .graph.analysis import valid_cut_points
+        max_s = min(args.nodes, len(valid_cut_points(graph)) + 1)
+        cuts_only = min((solve(graph, s, cm) for s in range(1, max_s + 1)),
+                        key=lambda p: p.bottleneck_s)
+        doc["cuts_only"] = cuts_only.to_json()
+        doc["predicted_speedup_vs_cuts_only"] = round(
+            cuts_only.bottleneck_s / plan.bottleneck_s, 4) \
+            if plan.bottleneck_s > 0 else None
+    elif args.sweep:
         sw = sweep_stages(graph, cm, max_stages=args.sweep,
                           latency_target_s=args.target_ms / 1e3
                           if args.target_ms else None)
@@ -255,7 +269,8 @@ def cmd_plan(args):
         doc["recommended"] = plan.to_json()
     else:
         if args.stages is None:
-            raise SystemExit("plan requires --stages (or --sweep MAX)")
+            raise SystemExit(
+                "plan requires --stages (or --sweep MAX / --nodes N)")
         plan = solve(graph, args.stages, cm)
         doc["plan"] = plan.to_json()
     if plan.num_stages > 1:
@@ -281,14 +296,25 @@ def cmd_plan(args):
           f"(gen {cm.gen}, link {cm.link_bw_s:.3g} B/s)")
     comm = plan.hop_comm_s + [0.0]
     codecs = plan.codecs + ["-"]
+    reps = getattr(plan, "replicas", None)
     for k, comp in enumerate(plan.stage_compute_s):
         mark = " <- bottleneck" if k == plan.bottleneck_stage else ""
-        print(f"  stage {k}: compute {comp * 1e3:10.4f} ms | "
+        rep = ""
+        if reps is not None and reps[k] > 1:
+            rep = (f" x{reps[k]} replicas -> "
+                   f"{comp / reps[k] * 1e3:.4f} ms")
+        print(f"  stage {k}: compute {comp * 1e3:10.4f} ms{rep} | "
               f"hop {comm[k] * 1e3:10.4f} ms ({codecs[k]}){mark}")
     print(f"  predicted bottleneck {plan.bottleneck_s * 1e3:.4f} ms "
           f"({plan.bound_by}-bound) -> "
           f"{plan.predicted_throughput_per_s(cm.batch):.2f} inf/s")
     print(f"  cuts: {','.join(plan.cuts) or '-'}")
+    if "cuts_only" in doc:
+        co = doc["cuts_only"]
+        print(f"  cuts-only baseline ({co['num_stages']} stages): "
+              f"bottleneck {co['bottleneck_ms']:.4f} ms (speedup "
+              f"{doc['predicted_speedup_vs_cuts_only']}x with "
+              f"{doc['plan']['num_nodes']} nodes)")
     if "quantile" in doc:
         q = doc["quantile"]
         print(f"  quantile baseline: bottleneck {q['bottleneck_ms']:.4f} "
@@ -416,15 +442,40 @@ def cmd_node(args):
     node = StageNode(args.artifact, args.listen, args.next,
                      codec=args.codec, overlap=not args.no_overlap,
                      rx_depth=args.rx_depth, tx_depth=args.tx_depth,
-                     inflight=args.inflight)
+                     inflight=args.inflight, fan_in=args.fan_in,
+                     replica=args.replica)
     what = (f"stage {node.manifest['index']} ({node.manifest['name']})"
             if node.manifest else "EMPTY (awaiting in-band deploy)")
+    if node.replica is not None:
+        what += f" replica {node.replica}"
+    if node.fan_in > 1:
+        what += f" fan-in {node.fan_in}"
     print(f"node: {what} listening on "
           f"{node.address[0]}:{node.address[1]}, next {args.next}"
           f"{' [serial]' if args.no_overlap else ''}",
           file=sys.stderr, flush=True)
     n = node.serve(connect_timeout_s=args.connect_timeout)
     print(f"node: served {n} tensors; chain drained", file=sys.stderr)
+
+
+def _parse_replicas(spec: str) -> dict[int, int]:
+    """``stage1=2,stage3=3`` (or bare ``1=2,3=3``) -> {1: 2, 3: 3}."""
+    out: dict[int, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if not v:
+            raise SystemExit(f"--replicas: {part!r} is not stageK=R")
+        k = k.strip().lower()
+        if k.startswith("stage"):
+            k = k[len("stage"):]
+        try:
+            out[int(k)] = int(v)
+        except ValueError:
+            raise SystemExit(f"--replicas: {part!r} is not stageK=R")
+    return out
 
 
 def cmd_chain(args):
@@ -454,24 +505,35 @@ def cmd_chain(args):
     xs = [rng.standard_normal((args.batch,) + in_spec.shape)
           .astype(np.float32) for _ in range(args.count)]
 
+    replicas = _parse_replicas(args.replicas)
+    stats: list = []
     t0 = time.perf_counter()
     outs = run_chain(stages, params, xs, batch=args.batch, codec=args.codec,
                      in_band=args.in_band, overlap=not args.no_overlap,
                      rx_depth=args.rx_depth, tx_depth=args.tx_depth,
-                     inflight=args.inflight)
+                     inflight=args.inflight, replicas=replicas or None,
+                     stats_out=stats)
     dt = time.perf_counter() - t0
 
     fwd = jax.jit(graph.apply)
     worst = max(float(np.abs(np.asarray(fwd(params, x)) - y).max())
                 for x, y in zip(xs, outs))
-    print(json.dumps({
+    row = {
         "metric": f"{args.model}_{len(stages)}proc_chain",
         "value": round(len(xs) * args.batch / dt, 3),
         "unit": "inferences/sec",
         "stages": len(stages), "codec": args.codec,
         "overlap": not args.no_overlap,
         "max_abs_err_vs_single_program": worst,
-    }))
+    }
+    if replicas:
+        row["replicas"] = {f"stage{k}": r
+                           for k, r in sorted(replicas.items())}
+        # per-replica aggregation: how the round-robin actually split
+        row["per_node_processed"] = [
+            {"stage": s.get("stage"), "replica": s.get("replica"),
+             "processed": s.get("processed")} for s in stats]
+    print(json.dumps(row))
     _obs_finish(args)
 
 
@@ -611,6 +673,10 @@ def main(argv=None):
                          "instead of the analytic roofline")
     pl.add_argument("--sweep", type=int, metavar="MAX",
                     help="solve every stage count 1..MAX and recommend")
+    pl.add_argument("--nodes", type=int, metavar="N",
+                    help="hybrid plan for a budget of N processes: "
+                         "jointly choose cuts AND per-stage replica "
+                         "counts (docs/PLANNER.md)")
     pl.add_argument("--target-ms", type=float, default=0.0,
                     help="bottleneck latency target for the --sweep "
                          "recommendation (fewest stages that meet it)")
@@ -652,8 +718,16 @@ def main(argv=None):
                          "result port); omit to receive it in-band")
     nd.add_argument("--codec", default="raw",
                     help="hop codec: raw | lzb | bf8/bf12/bf16 | "
-                         "sleep<ms>+<codec> (bench-only delay wrapper)")
+                         "sleep<ms>+<codec> (bench-only delay wrapper; "
+                         "esleep/dsleep delay one side only)")
     nd.add_argument("--connect-timeout", type=float, default=30.0)
+    nd.add_argument("--fan-in", type=int, default=1, metavar="R",
+                    help="merge R sequence-stamped upstream connections "
+                         "(this node sits downstream of a replicated "
+                         "stage) through a bounded reorder buffer")
+    nd.add_argument("--replica", type=int, default=None, metavar="N",
+                    help="this process is replica N of its stage "
+                         "(labels stageK.rN spans/stats)")
     _add_overlap_flags(nd)
 
     c = sub.add_parser("chain", help="spawn a local N-process chain and "
@@ -672,6 +746,10 @@ def main(argv=None):
     c.add_argument("--in-band", action="store_true",
                    help="boot nodes empty; ship artifacts over the "
                         "control handshake")
+    c.add_argument("--replicas", default="", metavar="stageK=R,...",
+                   help="run stage K as R data-parallel replica "
+                        "processes (ordered fan-out/fan-in; adjacent "
+                        "stages cannot both be replicated)")
     _add_overlap_flags(c)
     _add_obs_flags(c)
 

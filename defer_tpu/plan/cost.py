@@ -49,15 +49,25 @@ class CodecSpec:
     decode_bytes_per_s: float  #: host decode throughput on RAW bytes
     lossy: bool = False
 
-    def comm_seconds(self, raw_bytes: int, link_bw: float) -> float:
-        """encode + wire + decode seconds for one boundary tensor."""
+    def comm_parts(self, raw_bytes: int, link_bw: float
+                   ) -> tuple[float, float, float]:
+        """(encode, wire, decode) seconds for one boundary tensor —
+        split out because stage replication parallelizes the encode and
+        decode sides independently (``plan/solver.py``): the hop OUT of
+        an R-replica stage encodes on R processes at once, the hop INTO
+        one decodes on R, while the wire term serializes at whichever
+        single endpoint the fan terminates on."""
         enc = raw_bytes / self.encode_bytes_per_s \
             if self.encode_bytes_per_s > 0 else 0.0
         dec = raw_bytes / self.decode_bytes_per_s \
             if self.decode_bytes_per_s > 0 else 0.0
         wire = (raw_bytes / max(self.ratio, 1e-9)) / link_bw \
             if link_bw > 0 else 0.0
-        return enc + wire + dec
+        return enc, wire, dec
+
+    def comm_seconds(self, raw_bytes: int, link_bw: float) -> float:
+        """encode + wire + decode seconds for one boundary tensor."""
+        return sum(self.comm_parts(raw_bytes, link_bw))
 
 
 #: analytic defaults (order-of-magnitude host-edge numbers; calibrate on
@@ -219,6 +229,28 @@ class StageCostModel:
         """Cheapest (codec name, comm seconds) for the hop at ``cut``."""
         return min(((n, self.comm_seconds(cut, n)) for n in self.codecs),
                    key=lambda kv: kv[1])
+
+    def comm_parts(self, cut: str, codec: str
+                   ) -> tuple[float, float, float]:
+        """(encode, wire, decode) seconds for ``codec`` at ``cut``."""
+        return self.codecs[codec].comm_parts(self.cut_bytes(cut),
+                                             self.link_bw_s)
+
+    def best_codec_replicated(self, cut: str, r_up: int, r_down: int
+                              ) -> tuple[str, float]:
+        """Cheapest (codec, effective seconds) for the hop at ``cut``
+        when the upstream stage runs ``r_up`` replicas and the
+        downstream ``r_down``: the encode side is paid by r_up processes
+        in parallel, the decode side by r_down, and the wire serializes
+        at the fan's single endpoint — ``enc/r_up + wire + dec/r_down``.
+        """
+        best_name, best = None, float("inf")
+        for n in self.codecs:
+            enc, wire, dec = self.comm_parts(cut, n)
+            s = enc / max(r_up, 1) + wire + dec / max(r_down, 1)
+            if s < best:
+                best_name, best = n, s
+        return best_name, best
 
     def describe(self) -> dict:
         return {

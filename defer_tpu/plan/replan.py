@@ -29,7 +29,8 @@ import re
 
 from ..graph.ir import LayerGraph
 from .cost import StageCostModel
-from .solver import Plan, evaluate_cuts, solve
+from .solver import (Plan, ReplicatedPlan, evaluate_cuts, solve,
+                     solve_replicated)
 
 _STAGE_KEY = re.compile(r"(?:^|\.)stage(\d+)\.latency_s$")
 
@@ -44,26 +45,31 @@ def measured_stage_seconds(source, *, quantile: str = "p50",
     ``quantile`` picks the summary field (p50 by default — the
     steady-state number; mean is skewed by compile outliers).  ``scale``
     converts units if the source was exported scaled.
+
+    Replicated stages report one ``stats`` row per replica; their
+    per-frame service times are averaged into one per-stage figure (a
+    replica's latency measures the UNDIVIDED stage cost — the division
+    by R happens in the solver's objective, not in telemetry).
     """
-    out: dict[int, float] = {}
+    acc: dict[int, list[float]] = {}
 
     def take(stage: int, summ) -> None:
         if not isinstance(summ, dict) or not summ.get("count"):
             return
         v = summ.get(quantile, summ.get("mean"))
         if v is not None:
-            out[int(stage)] = float(v) * scale
+            acc.setdefault(int(stage), []).append(float(v) * scale)
 
     if isinstance(source, dict):
         for key, summ in source.items():
             m = _STAGE_KEY.search(key)
             if m:
                 take(int(m.group(1)), summ)
-    else:  # ChainDispatcher.stats reply list
+    else:  # ChainDispatcher.stats reply list (one row per replica)
         for row in source:
             if isinstance(row, dict) and row.get("stage") is not None:
                 take(row["stage"], row.get("infer_latency_s"))
-    return out
+    return {k: sum(vs) / len(vs) for k, vs in acc.items()}
 
 
 @dataclasses.dataclass
@@ -80,7 +86,9 @@ class ReplanResult:
     @property
     def moved(self) -> bool:
         return self.new_plan.cuts != self.old_plan.cuts \
-            or self.new_plan.codecs != self.old_plan.codecs
+            or self.new_plan.codecs != self.old_plan.codecs \
+            or getattr(self.new_plan, "replicas", None) \
+            != getattr(self.old_plan, "replicas", None)
 
     @property
     def predicted_improvement(self) -> float:
@@ -140,6 +148,12 @@ def replan(graph: LayerGraph, plan: Plan, source,
     :func:`measured_stage_seconds`).  ``cost`` defaults to a fresh
     analytic model matching the plan's stage count assumptions — pass
     the model the plan was built with when available.
+
+    A :class:`ReplicatedPlan` replans under the SAME node budget: the
+    corrected old plan keeps its cuts and replica counts, the new plan
+    re-runs :func:`solve_replicated` with ``num_nodes`` — so telemetry
+    can move replicas to whichever stage measurement proved slow, not
+    just move the cuts.
     """
     if cost is None:
         cost = StageCostModel(graph)
@@ -154,9 +168,16 @@ def replan(graph: LayerGraph, plan: Plan, source,
         pred = cost.compute_seconds(names)
         corrections[k] = (measured[k] / pred
                           if k in measured and pred > 0 else 1.0)
-    old_corrected = evaluate_cuts(graph, plan.cuts, corrected,
-                                  objective=plan.objective)
-    new_plan = solve(graph, plan.num_stages, corrected)
+    if isinstance(plan, ReplicatedPlan):
+        old_corrected = evaluate_cuts(graph, plan.cuts, corrected,
+                                      objective=plan.objective,
+                                      replicas=plan.replicas)
+        new_plan = solve_replicated(graph, corrected,
+                                    num_nodes=plan.num_nodes)
+    else:
+        old_corrected = evaluate_cuts(graph, plan.cuts, corrected,
+                                      objective=plan.objective)
+        new_plan = solve(graph, plan.num_stages, corrected)
     return ReplanResult(old_plan=plan, old_plan_corrected=old_corrected,
                         new_plan=new_plan, corrections=corrections,
                         measured_stage_s=measured)

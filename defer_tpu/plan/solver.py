@@ -130,17 +130,25 @@ def _mk_plan(graph, cost, chosen_idx, cuts, cum, total, comm,
 
 def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
                   cost: StageCostModel, *,
-                  objective: str = "explicit") -> Plan:
+                  objective: str = "explicit",
+                  replicas: list[int] | None = None) -> Plan:
     """Predictions for an *explicit* cut list under ``cost`` (cheapest
     codec per hop) — how quantile or hand-picked cuts score on the same
-    model the solver optimizes."""
+    model the solver optimizes.  ``replicas`` (one count per stage)
+    scores a replicated configuration instead: per-stage compute divides
+    by its count and each hop's codec is re-chosen for the fan-adjusted
+    ``enc/r_up + wire + dec/r_down`` cost."""
     cuts, cum, total, comm = _tables(graph, cost)
     pos = {c: i for i, c in enumerate(cuts)}
     missing = [c for c in cut_points if c not in pos]
     if missing:
         raise ValueError(f"not valid cut points: {missing}")
-    return _mk_plan(graph, cost, [pos[c] for c in cut_points],
-                    cuts, cum, total, comm, objective)
+    chosen = [pos[c] for c in cut_points]
+    if replicas is None:
+        return _mk_plan(graph, cost, chosen, cuts, cum, total, comm,
+                        objective)
+    return _mk_replicated_plan(graph, cost, chosen, cuts, cum, total,
+                               list(replicas), objective)
 
 
 def solve(graph: LayerGraph, num_stages: int, cost: StageCostModel, *,
@@ -333,3 +341,228 @@ def brute_force(graph: LayerGraph, num_stages: int,
             best_plan = p
     assert best_plan is not None
     return best_plan
+
+
+# -- hybrid pipeline/data-parallel: cuts + per-stage replica counts ----------
+
+
+@dataclasses.dataclass
+class ReplicatedPlan(Plan):
+    """A plan whose stages may run as R data-parallel replicas.
+
+    ``stage_compute_s`` stays the RAW (unreplicated) per-stage compute;
+    ``hop_comm_s`` holds the fan-adjusted effective hop seconds
+    (``enc/r_up + wire + dec/r_down`` at the chosen codec).  The
+    effective stage cost divides compute by the stage's replica count —
+    the runtime analogue being R replica processes each serving every
+    R-th microbatch (docs/PLANNER.md).
+    """
+
+    replicas: list[int] = dataclasses.field(default_factory=list)
+    num_nodes: int = 0
+
+    @property
+    def stage_cost_s(self) -> list[float]:
+        eff = [c / max(r, 1)
+               for c, r in zip(self.stage_compute_s, self.replicas)]
+        return [max(c, self.hop_comm_s[k]) if k < len(self.hop_comm_s)
+                else c for k, c in enumerate(eff)]
+
+    @property
+    def bound_by(self) -> str:
+        k = self.bottleneck_stage
+        eff = self.stage_compute_s[k] / max(self.replicas[k], 1)
+        if k < len(self.hop_comm_s) and self.hop_comm_s[k] > eff:
+            return "comm"
+        return "compute"
+
+    def to_json(self) -> dict:
+        d = super().to_json()
+        d["replicas"] = list(self.replicas)
+        d["num_nodes"] = self.num_nodes
+        d["stage_effective_ms"] = [
+            round(c / max(r, 1) * 1e3, 6)
+            for c, r in zip(self.stage_compute_s, self.replicas)]
+        return d
+
+
+def _mk_replicated_plan(graph, cost, chosen_idx, cuts, cum, total,
+                        replicas: list[int], objective: str
+                        ) -> ReplicatedPlan:
+    if len(replicas) != len(chosen_idx) + 1:
+        raise ValueError(
+            f"{len(chosen_idx) + 1} stages but {len(replicas)} replica "
+            f"counts")
+    if any(r < 1 for r in replicas):
+        raise ValueError(f"replica counts must be >= 1: {replicas}")
+    for k in range(len(replicas) - 1):
+        if replicas[k] > 1 and replicas[k + 1] > 1:
+            raise ValueError(
+                f"stages {k} and {k + 1} are both replicated; adjacent "
+                f"replication is not supported (a replica cannot restore "
+                f"another fan-out's order)")
+    bounds = [0.0] + [cum[i] for i in chosen_idx] + [total]
+    stage_compute = [bounds[k + 1] - bounds[k]
+                     for k in range(len(chosen_idx) + 1)]
+    hop_comm, codecs = [], []
+    for k, i in enumerate(chosen_idx):
+        name, s = cost.best_codec_replicated(cuts[i], replicas[k],
+                                             replicas[k + 1])
+        codecs.append(name)
+        hop_comm.append(s)
+    eff = [c / r for c, r in zip(stage_compute, replicas)]
+    bottleneck = max([max(c, hop_comm[k]) if k < len(hop_comm) else c
+                      for k, c in enumerate(eff)] or [0.0])
+    return ReplicatedPlan(
+        graph_name=graph.name, num_stages=len(chosen_idx) + 1,
+        cuts=[cuts[i] for i in chosen_idx], codecs=codecs,
+        stage_compute_s=stage_compute, hop_comm_s=hop_comm,
+        bottleneck_s=bottleneck, objective=objective,
+        cost=cost.describe(), replicas=list(replicas),
+        num_nodes=sum(replicas))
+
+
+def solve_replicated(graph: LayerGraph, cost: StageCostModel, *,
+                     num_nodes: int) -> ReplicatedPlan:
+    """Jointly optimal cuts AND per-stage replica counts for a budget of
+    ``num_nodes`` processes, minimizing::
+
+        max_k max(compute_k / r_k,
+                  min_codec enc_k/r_k + wire_k + dec_k/r_{k+1})
+
+    — the steady-state period of the hybrid pipeline/data-parallel
+    chain.  Replicating a stage divides its compute (and its share of
+    the adjoining hops' codec work) by R at the price of R-1 extra
+    nodes somewhere else; when no single fat stage dominates, the DP
+    simply returns more stages instead.  Adjacent stages cannot both be
+    replicated (runtime constraint: a replica cannot restore another
+    fan-out's sequence order).
+
+    O(C² · N³) dynamic program over (last cut, nodes used, last stage's
+    replica count); cross-checked against
+    :func:`brute_force_replicated` in the property tests.
+    """
+    if num_nodes < 1:
+        raise ValueError("num_nodes must be >= 1")
+    N = num_nodes
+    cuts, cum, total, _ = _tables(graph, cost)
+    C = len(cuts)
+    INF = float("inf")
+
+    # hop_tab[i][ru][rd]: cheapest effective hop seconds at cut i for
+    # upstream/downstream replica counts (codec argmin re-run per pair)
+    hop_tab = [[[cost.best_codec_replicated(cuts[i], ru, rd)[1]
+                 for rd in range(N + 1)] for ru in range(N + 1)]
+               for i in range(C)]
+
+    # dp[i][b][r]: best achievable max-so-far when the last completed
+    # stage ends at cut i, b nodes are spent, and that stage runs r
+    # replicas (the hop at cut i is NOT yet charged — it needs the next
+    # stage's count)
+    dp = [[[INF] * (N + 1) for _ in range(N + 1)] for _ in range(C)]
+    par: dict[tuple[int, int, int], tuple[int, int, int] | None] = {}
+    for i in range(C):
+        for r in range(1, N):  # >= 1 node must remain for later stages
+            dp[i][r][r] = cum[i] / r
+            par[(i, r, r)] = None
+    for b in range(1, N):
+        for i in range(C):
+            row = dp[i][b]
+            for r in range(1, b + 1):
+                v = row[r]
+                if v == INF:
+                    continue
+                for i2 in range(i + 1, C):
+                    seg = cum[i2] - cum[i]
+                    for r2 in range(1, N - b):
+                        if r > 1 and r2 > 1:
+                            continue  # adjacent replication forbidden
+                        val = max(v, hop_tab[i][r][r2], seg / r2)
+                        if val < dp[i2][b + r2][r2]:
+                            dp[i2][b + r2][r2] = val
+                            par[(i2, b + r2, r2)] = (i, b, r)
+
+    best_val, best_state, best_r_last = INF, None, 1
+    for r in range(1, N + 1):  # single stage: no cuts, r-way replicas
+        if total / r < best_val:
+            best_val, best_state, best_r_last = total / r, None, r
+    for i in range(C):
+        for b in range(1, N):
+            for r in range(1, b + 1):
+                v = dp[i][b][r]
+                if v == INF:
+                    continue
+                tail = total - cum[i]
+                for r2 in range(1, N - b + 1):
+                    if r > 1 and r2 > 1:
+                        continue
+                    val = max(v, hop_tab[i][r][r2], tail / r2)
+                    if val < best_val:
+                        best_val = val
+                        best_state = (i, b, r)
+                        best_r_last = r2
+
+    chosen: list[int] = []
+    replicas: list[int] = [best_r_last]
+    state = best_state
+    while state is not None:
+        i, b, r = state
+        chosen.append(i)
+        replicas.append(r)
+        state = par[(i, b, r)]
+    chosen.reverse()
+    replicas.reverse()
+    return _mk_replicated_plan(graph, cost, chosen, cuts, cum, total,
+                               replicas, "bottleneck_replicated")
+
+
+def brute_force_replicated(graph: LayerGraph, cost: StageCostModel, *,
+                           num_nodes: int) -> ReplicatedPlan:
+    """Exhaustive cuts x replica-count enumeration (test oracle for
+    :func:`solve_replicated`; keep the graph under ~8 valid cuts and
+    the budget under ~6)."""
+    import itertools
+    cuts, cum, total, _ = _tables(graph, cost)
+    N = num_nodes
+    best = None
+    for S in range(1, N + 1):
+        if S - 1 > len(cuts):
+            break
+        for combo in itertools.combinations(range(len(cuts)), S - 1):
+            for reps in itertools.product(range(1, N + 1), repeat=S):
+                if sum(reps) > N:
+                    continue
+                if any(reps[k] > 1 and reps[k + 1] > 1
+                       for k in range(S - 1)):
+                    continue
+                p = _mk_replicated_plan(graph, cost, list(combo), cuts,
+                                        cum, total, list(reps),
+                                        "brute_force_replicated")
+                if best is None or p.bottleneck_s < best.bottleneck_s:
+                    best = p
+    assert best is not None
+    return best
+
+
+def sweep_nodes(graph: LayerGraph, cost: StageCostModel, *,
+                max_nodes: int,
+                latency_target_s: float | None = None) -> dict:
+    """:func:`solve_replicated` for every node budget 1..max and pick a
+    recommendation — the replication-aware analogue of
+    :func:`sweep_stages`.  Without a target: the budget minimizing the
+    bottleneck (ties to the fewest nodes).  With ``latency_target_s``:
+    the FEWEST nodes whose bottleneck meets the target, falling back to
+    the overall best when nothing does."""
+    plans = [solve_replicated(graph, cost, num_nodes=n)
+             for n in range(1, max_nodes + 1)]
+    pick = min(plans, key=lambda p: (p.bottleneck_s, p.num_nodes))
+    met = None
+    if latency_target_s is not None:
+        feasible = [p for p in plans if p.bottleneck_s <= latency_target_s]
+        if feasible:
+            pick = min(feasible, key=lambda p: p.num_nodes)
+            met = True
+        else:
+            met = False
+    return {"plans": plans, "recommended": pick,
+            "latency_target_s": latency_target_s, "target_met": met}

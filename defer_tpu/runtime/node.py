@@ -41,8 +41,10 @@ import numpy as np
 from ..obs import REGISTRY, new_span_id, tracer
 from ..transport.channel import AsyncReceiver, AsyncSender
 from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
-                                configure_socket, recv_expect, recv_frame,
-                                send_ack, send_ctrl, send_end, send_frame)
+                                K_TENSOR_SEQ, configure_socket, recv_expect,
+                                recv_frame, send_ack, send_ctrl, send_end,
+                                send_frame)
+from ..transport.replicate import FanInMerge, FanOutSender
 
 
 def _connect_retry(host: str, port: int, timeout_s: float = 30.0
@@ -68,6 +70,13 @@ def _parse_hostport(s: str, default_host: str = "127.0.0.1"
     return (host or default_host), int(port)
 
 
+def _parse_hops(s: str) -> list[tuple[str, int]]:
+    """``host:port[,host:port...]`` -> list of (host, port).  More than
+    one entry means the downstream stage is replicated: the sender fans
+    out round-robin with sequence numbers (docs/TRANSPORT.md)."""
+    return [_parse_hostport(p) for p in s.split(",") if p]
+
+
 class StageNode:
     """One compute node of a process chain: recv -> stage fn -> relay.
 
@@ -77,6 +86,13 @@ class StageNode:
     nothing and gets its model over the wire (src/node.py:20-55).
     ``--artifact stage_k.zip --next host:5000`` pre-loads from a local
     file instead (the r3/r4 behavior, kept for pre-provisioned hosts).
+
+    Replication (docs/TRANSPORT.md): ``--next`` may name R comma-
+    separated replicas of the downstream stage — frames then fan out
+    round-robin with sequence numbers.  ``--fan-in R`` declares R
+    sequence-stamped upstream connections, merged through a bounded
+    reorder buffer that releases frames strictly in order.  ``--replica
+    N`` labels this process's spans/stats as replica N of its stage.
     """
 
     #: class-level defaults so instances built via ``__new__`` (tests)
@@ -87,11 +103,15 @@ class StageNode:
     rx_depth: int = 8
     tx_depth: int = 8
     inflight: int = 2
+    fan_in: int = 1
+    replica: int | None = None
+    next_hops: list[tuple[str, int]] | None = None
 
     def __init__(self, artifact: str | None, listen: str,
                  next_hop: str | None, *, codec: str = "raw",
                  overlap: bool = True, rx_depth: int = 8,
-                 tx_depth: int = 8, inflight: int = 2):
+                 tx_depth: int = 8, inflight: int = 2,
+                 fan_in: int = 1, replica: int | None = None):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
         # process exists
@@ -102,28 +122,75 @@ class StageNode:
         if artifact is not None:
             from ..utils.export import load_stage_program
             self.prog = load_stage_program(artifact)
-        self.next_hop = _parse_hostport(next_hop) if next_hop else None
+        self.next_hops = _parse_hops(next_hop) if next_hop else None
         self.codec = codec
         self.overlap = overlap
         self.rx_depth = rx_depth
         self.tx_depth = tx_depth
         self.inflight = max(1, inflight)
+        self.fan_in = max(1, fan_in)
+        self.replica = replica
         self.processed = 0    # tensors relayed, lifetime
         self.reweights = 0    # weights-only re-pushes accepted
         #: trace-context K_CTRL received from upstream, held until this
         #: node opens its downstream connection so the context cascades
         #: hop by hop through the whole chain
         self._pending_trace: dict | None = None
+        #: fan-in state: the reorder merge shared by the upstream reader
+        #: connections and the single compute loop (lazy, lock-guarded)
+        self._merge: FanInMerge | None = None
+        self._merge_lock = threading.Lock()
+        self._done_q = None   # serve()'s completion queue (set per serve)
 
     @property
     def manifest(self):
         return None if self.prog is None else self.prog.manifest
 
+    @property
+    def next_hop(self) -> tuple[str, int] | None:
+        """First downstream hop (back-compat accessor; ``next_hops``
+        holds the full replica list)."""
+        return self.next_hops[0] if self.next_hops else None
+
+    @next_hop.setter
+    def next_hop(self, value: tuple[str, int] | None) -> None:
+        self.next_hops = None if value is None else [value]
+
     def _span_label(self) -> str:
-        """Span/track prefix for this node's rx/tx/infer telemetry."""
+        """Span/track prefix for this node's rx/tx/infer telemetry;
+        replicas get a ``stageK.rN`` prefix so traces show the
+        interleave across the parallel paths."""
         m = self.manifest
-        return (f"stage{m['index']}" if m is not None
+        base = (f"stage{m['index']}" if m is not None
                 else f"node{self.address[1]}")
+        return base if self.replica is None else f"{base}.r{self.replica}"
+
+    def _make_tx(self, connect_timeout_s: float):
+        """Open the downstream connection(s): one :class:`AsyncSender`,
+        or a :class:`FanOutSender` round-robining across a replicated
+        downstream stage (announced with a ``stream_begin`` control
+        frame so even a replica that ends up with zero frames knows it
+        is on the data path)."""
+        if not self.next_hops:
+            raise ValueError("no next hop configured")
+        socks = [_connect_retry(*h, timeout_s=connect_timeout_s)
+                 for h in self.next_hops]
+        if len(socks) == 1:
+            tx = AsyncSender(socks[0], depth=self.tx_depth,
+                             codec=self.codec,
+                             gauge="node.tx_queue_depth",
+                             span=self._span_label)
+        else:
+            tx = FanOutSender(socks, depth=self.tx_depth,
+                              codec=self.codec,
+                              gauge="node.tx_queue_depth",
+                              span=self._span_label)
+            tx.send_ctrl({"cmd": "stream_begin"})
+        if self._pending_trace is not None:
+            # cascade the dispatcher's trace context down the chain
+            # (broadcast on fan-out) ahead of the first relayed tensor
+            tx.send_ctrl(self._pending_trace)
+        return tx, socks
 
     def _handle_ctrl(self, conn, msg: dict, recv=None) -> bool:
         """One control command; True if the connection should keep serving.
@@ -165,9 +232,13 @@ class StageNode:
             blob = _expect(K_BYTES)
             self.prog = load_stage_program(blob)
             if msg.get("next"):
-                self.next_hop = _parse_hostport(msg["next"])
+                self.next_hops = _parse_hops(msg["next"])
             if msg.get("codec"):
                 self.codec = msg["codec"]
+            if msg.get("fan_in"):
+                self.fan_in = max(1, int(msg["fan_in"]))
+            if msg.get("replica") is not None:
+                self.replica = int(msg["replica"])
             send_ack(conn)
             return True
         if cmd == "reweight":
@@ -202,11 +273,13 @@ class StageNode:
             send_ctrl(conn, {
                 "stage": None if m is None else m["index"],
                 "name": None if m is None else m["name"],
+                "replica": self.replica,
+                "fan_in": self.fan_in,
                 "processed": self.processed,
                 "reweights": self.reweights,
                 "codec": self.codec,
-                "next": None if self.next_hop is None
-                else f"{self.next_hop[0]}:{self.next_hop[1]}",
+                "next": None if not self.next_hops
+                else ",".join(f"{h}:{p}" for h, p in self.next_hops),
                 # wire telemetry: this node's process-local transport view
                 "tx_frames": reg.counter("transport.tx_frames").value,
                 "tx_bytes": reg.counter("transport.tx_bytes").value,
@@ -241,6 +314,7 @@ class StageNode:
         import threading
 
         done: _q.Queue = _q.Queue()
+        self._done_q = done  # the fan-in compute loop reports here too
 
         def worker(conn):
             try:
@@ -280,7 +354,12 @@ class StageNode:
         (:meth:`_serve_conn_overlapped`); ``overlap=False`` keeps the
         strictly serial recv -> infer -> send loop as the measurable
         baseline (``--no-overlap``, ``scripts/chain_overlap_smoke.py``).
+        With ``fan_in > 1`` every connection instead feeds the shared
+        reorder merge (:meth:`_serve_conn_fanin`) and ONE compute loop
+        consumes the merged in-order stream.
         """
+        if self.fan_in > 1:
+            return self._serve_conn_fanin(conn, connect_timeout_s)
         if self.overlap:
             return self._serve_conn_overlapped(conn, connect_timeout_s)
         return self._serve_conn_serial(conn, connect_timeout_s)
@@ -303,12 +382,18 @@ class StageNode:
 
         ``node.infer_s`` here measures issue-to-materialize (device queue
         included), matching what the overlap actually hides.
+
+        Sequence-stamped frames (``K_TENSOR_SEQ`` — this node is a
+        replica on a fan-out path) relay their sequence number onto the
+        output frame unchanged, so the downstream fan-in can restore
+        stream order.
         """
-        out = None
+        out_socks = None
         tx = None
         n = 0                   # tensors relayed downstream
         seq = 0                 # tensors received
         streamed = False
+        stream_marked = False   # upstream announced this conn as data path
         infer_hist = REGISTRY.histogram("node.infer_s")
         inflight_g = REGISTRY.gauge("node.inflight")
         #: issued-but-unsynced stage outputs, oldest first
@@ -321,7 +406,7 @@ class StageNode:
 
         def drain_one():
             nonlocal n, streamed
-            t0, s, y = pending.popleft()
+            t0, s, y, relay_seq = pending.popleft()
             inflight_g.v = len(pending)
             y = np.asarray(y)  # host sync of the OLDEST in-flight output
             dt = time.perf_counter() - t0
@@ -329,11 +414,11 @@ class StageNode:
             tr = tracer()
             if tr.enabled:
                 tr.record(
-                    f"stage{self.manifest['index']}.infer", t0, dt,
+                    f"{self._span_label()}.infer", t0, dt,
                     {"seq": s, "stage": self.manifest["index"]})
             self.processed += 1  # before the send: a stats query can
             #   race the relay of the final tensor otherwise
-            tx.send(y)
+            tx.send(y, seq=relay_seq)
             n += 1
             streamed = True
 
@@ -355,13 +440,26 @@ class StageNode:
                 if kind == K_END:
                     while pending:
                         drain_one()
-                    if streamed:
+                    if streamed or stream_marked:
+                        if tx is None:
+                            # marked data path, zero frames (fewer inputs
+                            # than replicas): still propagate the stream
+                            # shape so the downstream fan-in's END count
+                            # and the result server's dial-back hold
+                            tx, out_socks = self._make_tx(
+                                connect_timeout_s)
+                            if not isinstance(tx, FanOutSender):
+                                tx.send_ctrl({"cmd": "stream_begin"})
                         # END + join: every relayed frame is on the wire
                         # before the finally block closes the socket
                         tx.close(timeout=connect_timeout_s)
                         return n
                     return None  # control connection closing
                 if kind == K_CTRL:
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "stream_begin":
+                        stream_marked = True
+                        continue
                     is_trace = (isinstance(value, dict)
                                 and value.get("cmd") == "trace")
                     if is_trace:
@@ -376,33 +474,26 @@ class StageNode:
                         # context now, not just at connection open
                         tx.send_ctrl(self._pending_trace)
                     continue
-                if kind != K_TENSOR:
+                if kind == K_TENSOR_SEQ:
+                    relay_seq, value = value
+                elif kind == K_TENSOR:
+                    relay_seq = None
+                else:
                     raise ValueError(f"unexpected frame kind {kind}")
                 if self.prog is None:
                     raise ValueError(
                         "data frame before any stage artifact (boot with "
                         "--artifact or deploy in-band first)")
-                if out is None:
-                    if self.next_hop is None:
-                        raise ValueError("no next hop configured")
-                    out = _connect_retry(*self.next_hop,
-                                         timeout_s=connect_timeout_s)
+                if tx is None:
+                    tx, out_socks = self._make_tx(connect_timeout_s)
                     rx.bind_gauge("node.rx_queue_depth")
-                    tx = AsyncSender(out, depth=self.tx_depth,
-                                     codec=self.codec,
-                                     gauge="node.tx_queue_depth",
-                                     span=self._span_label)
-                    if self._pending_trace is not None:
-                        # cascade the dispatcher's trace context down the
-                        # chain ahead of the first relayed tensor
-                        tx.send_ctrl(self._pending_trace)
                 want = tuple(self.manifest["in_shape"])
                 if tuple(value.shape[1:]) != want:
                     raise ValueError(
                         f"stage {self.manifest['index']} expects sample "
                         f"shape {want}, got {tuple(value.shape[1:])}")
                 t0 = time.perf_counter()
-                pending.append((t0, seq, self.prog(value)))  # no sync yet
+                pending.append((t0, seq, self.prog(value), relay_seq))
                 seq += 1
                 inflight_g.v = len(pending)
                 while len(pending) >= self.inflight:
@@ -418,8 +509,9 @@ class StageNode:
                   file=sys.stderr, flush=True)
             return None
         finally:
-            if out is not None:
-                out.close()
+            if out_socks is not None:
+                for s in out_socks:
+                    s.close()
 
     def _serve_conn_serial(self, conn, connect_timeout_s: float) -> int | None:
         """The pre-overlap serial loop: per tensor, rx + decode, compute
@@ -428,16 +520,27 @@ class StageNode:
         out = None
         n = 0
         streamed = False
+        stream_marked = False
         infer_hist = REGISTRY.histogram("node.infer_s")
         try:
             while True:
                 kind, value = recv_frame(conn)
                 if kind == K_END:
-                    if streamed:
+                    if streamed or stream_marked:
+                        if out is None:
+                            if self.next_hop is None:
+                                raise ValueError("no next hop configured")
+                            out = _connect_retry(*self.next_hop,
+                                                 timeout_s=connect_timeout_s)
+                            send_ctrl(out, {"cmd": "stream_begin"})
                         send_end(out)
                         return n
                     return None  # control connection closing
                 if kind == K_CTRL:
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "stream_begin":
+                        stream_marked = True
+                        continue
                     self._handle_ctrl(conn, value)
                     if (isinstance(value, dict)
                             and value.get("cmd") == "trace"
@@ -447,7 +550,11 @@ class StageNode:
                         # context now, not just at connection open
                         send_ctrl(out, self._pending_trace)
                     continue
-                if kind != K_TENSOR:
+                if kind == K_TENSOR_SEQ:
+                    relay_seq, value = value
+                elif kind == K_TENSOR:
+                    relay_seq = None
+                else:
                     raise ValueError(f"unexpected frame kind {kind}")
                 if self.prog is None:
                     raise ValueError(
@@ -456,6 +563,10 @@ class StageNode:
                 if out is None:
                     if self.next_hop is None:
                         raise ValueError("no next hop configured")
+                    if self.next_hops and len(self.next_hops) > 1:
+                        raise ValueError(
+                            "fan-out requires the overlapped node loop "
+                            "(drop --no-overlap)")
                     out = _connect_retry(*self.next_hop,
                                          timeout_s=connect_timeout_s)
                     if self._pending_trace is not None:
@@ -474,11 +585,11 @@ class StageNode:
                 tr = tracer()
                 if tr.enabled:
                     tr.record(
-                        f"stage{self.manifest['index']}.infer", t0, dt,
+                        f"{self._span_label()}.infer", t0, dt,
                         {"seq": n, "stage": self.manifest["index"]})
                 self.processed += 1  # before the send: a stats query can
                 #   race the relay of the final tensor otherwise
-                send_frame(out, y, codec=self.codec)
+                send_frame(out, y, codec=self.codec, seq=relay_seq)
                 n += 1
                 streamed = True
         except Exception as e:  # noqa: BLE001 — see below
@@ -490,6 +601,183 @@ class StageNode:
         finally:
             if out is not None:
                 out.close()
+
+    # -- fan-in (this node merges R replicated upstreams) --------------------
+
+    def _serve_conn_fanin(self, conn, connect_timeout_s: float) -> None:
+        """One upstream connection of a fan-in node: a reader loop that
+        decodes frames on THIS thread (R connections = R parallel
+        decoders) and feeds sequence-stamped tensors into the shared
+        reorder merge.  Control connections (deploy / stats / reweight)
+        are served inline exactly as before.  Always returns ``None`` —
+        the merged compute loop (:meth:`_merge_compute`) is the one
+        producer of the stream's tensor count."""
+        registered = False
+        try:
+            while True:
+                kind, value = recv_frame(conn)
+                if kind == K_END:
+                    if registered:
+                        self._merge.end()
+                    return None
+                if kind == K_CTRL:
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "stream_begin":
+                        # the upstream fan-out marks every replica path,
+                        # so even a zero-frame upstream is counted in the
+                        # merge's END bookkeeping
+                        if not registered:
+                            registered = True
+                            self._ensure_merge_loop(connect_timeout_s)
+                        continue
+                    self._handle_ctrl(conn, value)
+                    if registered and isinstance(value, dict) \
+                            and value.get("cmd") == "trace":
+                        # a trace context arriving MID-STREAM (second
+                        # traced stream on a live chain) must still
+                        # cascade past an already-open downstream
+                        # connection: ride it through the merge so the
+                        # compute loop re-sends it (duplicates across
+                        # the R paths are harmless — adoption is
+                        # idempotent and the dispatcher skips them)
+                        self._merge.put_ctrl(dict(self._pending_trace))
+                    continue
+                if kind == K_TENSOR:
+                    raise ValueError(
+                        "fan-in node received an unsequenced tensor "
+                        "frame — the upstream must fan out with "
+                        "sequence numbers (K_TENSOR_SEQ)")
+                if kind != K_TENSOR_SEQ:
+                    raise ValueError(f"unexpected frame kind {kind}")
+                seq, arr = value
+                if not registered:
+                    registered = True
+                    self._ensure_merge_loop(connect_timeout_s)
+                t0 = time.perf_counter()
+                self._merge.put(seq, arr)
+                tr = tracer()
+                if tr.enabled:
+                    tr.record(f"{self._span_label()}.merge_wait", t0,
+                              time.perf_counter() - t0, {"seq": seq})
+        except Exception as e:  # noqa: BLE001 — policy matches the
+            # single-upstream loops: a registered data path fails loudly
+            # (and poisons the merge so the compute loop fails too); a
+            # connection that never streamed is logged and dropped
+            if registered:
+                self._merge.fail(e)
+                raise
+            print(f"node: dropped connection before streaming: {e!r}",
+                  file=sys.stderr, flush=True)
+            return None
+
+    def _ensure_merge_loop(self, connect_timeout_s: float) -> None:
+        """Create the shared reorder merge and its single compute thread
+        the first time an upstream turns out to be a data path."""
+        with self._merge_lock:
+            if self._merge is not None:
+                return
+            # capacity: every upstream gets rx_depth frames of reorder
+            # slack before backpressure parks its reader thread
+            self._merge = FanInMerge(
+                self.fan_in,
+                capacity=max(self.fan_in, self.fan_in * self.rx_depth))
+            t = threading.Thread(
+                target=self._merge_loop, args=(connect_timeout_s,),
+                daemon=True, name="node-merge-compute")
+            t.start()
+
+    def _merge_loop(self, connect_timeout_s: float) -> None:
+        done = self._done_q
+        try:
+            done.put(self._merge_compute(connect_timeout_s))
+        except BaseException as e:  # noqa: BLE001 — surfaced via serve()
+            self._merge.fail(e)  # wake readers parked in put()
+            done.put(e)
+
+    def _merge_compute(self, connect_timeout_s: float) -> int:
+        """The fan-in node's compute loop: consume the merged in-order
+        stream, keep up to ``inflight`` dispatches un-synced (draining
+        greedily whenever the merge has no in-order frame ready), relay
+        downstream.  Same shape as :meth:`_serve_conn_overlapped`, with
+        the reorder merge in place of the single rx channel."""
+        import queue as _q
+
+        tx = None
+        out_socks = None
+        n = 0
+        seq = 0
+        infer_hist = REGISTRY.histogram("node.infer_s")
+        inflight_g = REGISTRY.gauge("node.inflight")
+        merge_g = REGISTRY.gauge("node.merge_depth")
+        pending: collections.deque = collections.deque()
+
+        def drain_one():
+            nonlocal n
+            t0, s, y = pending.popleft()
+            inflight_g.v = len(pending)
+            y = np.asarray(y)
+            dt = time.perf_counter() - t0
+            infer_hist.record(dt)
+            tr = tracer()
+            if tr.enabled:
+                tr.record(f"{self._span_label()}.infer", t0, dt,
+                          {"seq": s, "stage": self.manifest["index"]})
+            self.processed += 1
+            tx.send(y)
+            n += 1
+
+        try:
+            while True:
+                if pending:
+                    try:
+                        kind, value = self._merge.get_nowait()
+                    except _q.Empty:
+                        drain_one()
+                        continue
+                else:
+                    kind, value = self._merge.get()
+                merge_g.v = self._merge.qsize()
+                if kind == K_END:
+                    while pending:
+                        drain_one()
+                    if tx is None:
+                        # all upstreams were zero-frame paths: still
+                        # propagate the stream downstream (see the
+                        # overlapped loop's marked-but-empty branch)
+                        tx, out_socks = self._make_tx(connect_timeout_s)
+                        if not isinstance(tx, FanOutSender):
+                            tx.send_ctrl({"cmd": "stream_begin"})
+                    tx.close(timeout=connect_timeout_s)
+                    return n
+                if kind == K_CTRL:
+                    # the readers handled the command (trace adoption);
+                    # what rides through the merge is the cascade copy
+                    # for downstream — forward it if tx is already open
+                    # (at open, _make_tx sends _pending_trace itself)
+                    if tx is not None and value is not None:
+                        tx.send_ctrl(value)
+                    continue
+                if self.prog is None:
+                    raise ValueError(
+                        "data frame before any stage artifact (boot with "
+                        "--artifact or deploy in-band first)")
+                if tx is None:
+                    tx, out_socks = self._make_tx(connect_timeout_s)
+                want = tuple(self.manifest["in_shape"])
+                if tuple(value.shape[1:]) != want:
+                    raise ValueError(
+                        f"stage {self.manifest['index']} expects sample "
+                        f"shape {want}, got {tuple(value.shape[1:])}")
+                t0 = time.perf_counter()
+                pending.append((t0, seq, self.prog(value)))
+                seq += 1
+                inflight_g.v = len(pending)
+                while len(pending) >= self.inflight:
+                    drain_one()
+        finally:
+            if out_socks is not None:
+                for s in out_socks:
+                    s.close()
 
 
 class ChainDispatcher:
@@ -507,13 +795,17 @@ class ChainDispatcher:
     timeout_s: float = 180.0
     tx_depth: int = 8
     rx_depth: int = 8
-    _tx_chan: AsyncSender | None = None
+    result_fan_in: int = 1
+    _tx_chan = None              # AsyncSender | FanOutSender | None
     _rx_chan: AsyncReceiver | None = None
+    _send_socks: list | None = None
+    _res_merge: FanInMerge | None = None
 
     def __init__(self, first_hop: str, *, listen: str = "127.0.0.1:0",
                  codec: str = "raw", window: int = 64,
                  timeout_s: float | None = None,
-                 tx_depth: int = 8, rx_depth: int = 8):
+                 tx_depth: int = 8, rx_depth: int = 8,
+                 result_fan_in: int = 1):
         if timeout_s is not None:
             self.timeout_s = timeout_s
         host, port = _parse_hostport(listen)
@@ -521,30 +813,50 @@ class ChainDispatcher:
         # a dead chain fails, not hangs
         self._res_srv.settimeout(self.timeout_s)
         self.result_address = self._res_srv.getsockname()
+        #: comma-separated list = replicated first stage: the dispatcher
+        #: itself fans out round-robin with sequence numbers
         self.first_hop = first_hop
         self.codec = codec
         self.window = window
         self.tx_depth = tx_depth
         self.rx_depth = rx_depth
+        #: >1 = replicated LAST stage: R replicas dial the result server
+        #: back and the dispatcher merges them in sequence order
+        self.result_fan_in = max(1, result_fan_in)
         self._send_sock: socket.socket | None = None
+        self._send_socks = None
         self._res_conn: socket.socket | None = None
+        self._res_conns: list[socket.socket] = []
         self._tx_chan = None
         self._rx_chan = None
+        self._res_merge = None
 
     def _ensure_connected(self):
-        if self._send_sock is None:
+        if self._send_sock is None and self._send_socks is None:
             # generous: every node in the chain cold-imports jax first
-            self._send_sock = _connect_retry(
-                *_parse_hostport(self.first_hop), timeout_s=self.timeout_s)
+            socks = [_connect_retry(*h, timeout_s=self.timeout_s)
+                     for h in _parse_hops(self.first_hop)]
+            if len(socks) == 1:
+                self._send_sock = socks[0]
+            else:
+                self._send_socks = socks
         if self._tx_chan is None:
             # encode + send happen on the channel's tx thread, so the
             # feed loop's np.asarray and the wire overlap (and the END in
             # close() rides the same ordered queue)
-            self._tx_chan = AsyncSender(self._send_sock,
-                                        depth=self.tx_depth,
-                                        codec=self.codec,
-                                        gauge="chain.tx_queue_depth",
-                                        span="chain")
+            if self._send_socks is not None:
+                self._tx_chan = FanOutSender(self._send_socks,
+                                             depth=self.tx_depth,
+                                             codec=self.codec,
+                                             gauge="chain.tx_queue_depth",
+                                             span="chain")
+                self._tx_chan.send_ctrl({"cmd": "stream_begin"})
+            else:
+                self._tx_chan = AsyncSender(self._send_sock,
+                                            depth=self.tx_depth,
+                                            codec=self.codec,
+                                            gauge="chain.tx_queue_depth",
+                                            span="chain")
         # the result connection is accepted lazily in _recv_tensor: the
         # last node only dials back once its first tensor arrives, so
         # accepting before sending anything would deadlock the chain
@@ -641,9 +953,11 @@ class ChainDispatcher:
                       span_id=root_span)
         return outs
 
-    def deploy(self, stages, params, node_addrs: Sequence[str], *,
-               batch: int = 1, result_hop: str | None = None):
-        """Ship each stage's artifact to its node over the control channel.
+    def deploy(self, stages, params, node_addrs: Sequence, *,
+               batch: int = 1, result_hop: str | None = None,
+               codecs: Sequence[str] | None = None):
+        """Ship each stage's artifact to its node(s) over the control
+        channel.
 
         Serial, in chain order, each ACKed before the next — the in-band
         model distribution of the reference dispatcher
@@ -653,26 +967,47 @@ class ChainDispatcher:
         pre-placed files at all.  ``result_hop`` overrides the address the
         last node relays results to (defaults to this dispatcher's result
         server, reference src/dispatcher.py:51-55).
+
+        Replication: an entry of ``node_addrs`` may itself be a list of
+        R addresses — the SAME artifact is deployed to each replica, the
+        previous stage's ``next`` becomes the comma-joined replica list
+        (fan-out), and the following stage is told ``fan_in=R`` (merge).
+        Adjacent replicated stages are rejected — a replica cannot
+        restore another fan-out's order.  ``codecs`` (per stage) sets
+        each stage's OUTBOUND hop codec; default: this dispatcher's.
         """
         from ..utils.export import export_stage_bytes
-        addrs = list(node_addrs)
-        if len(addrs) != len(stages):
-            raise ValueError(f"{len(stages)} stages but {len(addrs)} nodes")
+        groups = [[a] if isinstance(a, str) else list(a)
+                  for a in node_addrs]
+        if len(groups) != len(stages):
+            raise ValueError(f"{len(stages)} stages but {len(groups)} nodes")
+        for i in range(len(groups) - 1):
+            if len(groups[i]) > 1 and len(groups[i + 1]) > 1:
+                raise ValueError(
+                    f"stages {i} and {i + 1} are both replicated; "
+                    f"adjacent replication is not supported")
         result_hop = result_hop or \
             f"{self.result_address[0]}:{self.result_address[1]}"
-        for i, (stage, addr) in enumerate(zip(stages, addrs)):
-            nxt = addrs[i + 1] if i + 1 < len(addrs) else result_hop
+        for i, (stage, addrs) in enumerate(zip(stages, groups)):
+            nxt = ",".join(groups[i + 1]) if i + 1 < len(groups) \
+                else result_hop
             blob = export_stage_bytes(stage, params, batch=batch)
-            s = _connect_retry(*_parse_hostport(addr),
-                               timeout_s=self.timeout_s)
-            try:
-                send_ctrl(s, {"cmd": "deploy", "next": nxt,
-                              "codec": self.codec})
-                send_frame(s, blob)
-                recv_expect(s, K_ACK)
-                send_end(s)
-            finally:
-                s.close()
+            for j, addr in enumerate(addrs):
+                msg = {"cmd": "deploy", "next": nxt,
+                       "codec": codecs[i] if codecs else self.codec}
+                if i > 0 and len(groups[i - 1]) > 1:
+                    msg["fan_in"] = len(groups[i - 1])
+                if len(addrs) > 1:
+                    msg["replica"] = j
+                s = _connect_retry(*_parse_hostport(addr),
+                                   timeout_s=self.timeout_s)
+                try:
+                    send_ctrl(s, msg)
+                    send_frame(s, blob)
+                    recv_expect(s, K_ACK)
+                    send_end(s)
+                finally:
+                    s.close()
 
     def reweight(self, stages, params, node_addrs: Sequence[str]):
         """Weights-only re-push: install fresh weights on every node's
@@ -721,7 +1056,13 @@ class ChainDispatcher:
         hands j back to the caller.  The per-``get`` timeout keeps the
         dead-chain-fails-not-hangs contract; the socket itself stays
         blocking so an idle (but healthy) chain never desyncs mid-frame.
+
+        With ``result_fan_in > 1`` (replicated last stage) the results
+        instead come off the sequence-ordered :class:`FanInMerge` over
+        the R replica dial-backs.
         """
+        if self.result_fan_in > 1:
+            return self._recv_tensor_fanin()
         if self._res_conn is None:
             self._res_conn, _ = self._res_srv.accept()
             configure_socket(self._res_conn)
@@ -733,14 +1074,73 @@ class ChainDispatcher:
                                           span="chain")
         kind, y = self._rx_chan.get(timeout=self.timeout_s)
         while kind == K_CTRL and isinstance(y, dict) \
-                and y.get("cmd") == "trace":
-            # the last node cascaded the trace context to the result hop;
-            # informational — the dispatcher originated it
+                and y.get("cmd") in ("trace", "stream_begin"):
+            # the last node cascaded the trace context / stream marker to
+            # the result hop; informational — the dispatcher originated it
             kind, y = self._rx_chan.get(timeout=self.timeout_s)
         if kind != K_TENSOR:
             raise ConnectionError(
                 f"chain returned frame kind {kind!r} while results were "
                 f"still in flight (a stage node died and cascaded END?)")
+        return y
+
+    def _ensure_result_merge(self) -> FanInMerge:
+        """Start the result-side fan-in: a background acceptor takes the
+        R replica dial-backs AS THEY COME (a replica that sees its first
+        frame late — or only the END — dials late; blocking for all R up
+        front would deadlock short streams) and one reader thread per
+        connection feeds the sequence-ordered merge."""
+        if self._res_merge is not None:
+            return self._res_merge
+        merge = FanInMerge(
+            self.result_fan_in,
+            capacity=max(self.result_fan_in,
+                         self.result_fan_in * self.rx_depth))
+        self._res_merge = merge
+
+        def reader(c):
+            try:
+                while True:
+                    kind, value = recv_frame(c)
+                    if kind == K_END:
+                        merge.end()
+                        return
+                    if kind == K_CTRL:
+                        continue  # trace / stream_begin: informational
+                    if kind != K_TENSOR_SEQ:
+                        raise ConnectionError(
+                            f"result fan-in got frame kind {kind!r}; "
+                            f"replicas must relay sequence-stamped frames")
+                    merge.put(*value)
+            except BaseException as e:  # noqa: BLE001 — surfaced in get()
+                merge.fail(e)
+
+        def acceptor():
+            try:
+                for _ in range(self.result_fan_in):
+                    c, _ = self._res_srv.accept()
+                    configure_socket(c)
+                    c.settimeout(None)
+                    self._res_conns.append(c)
+                    threading.Thread(target=reader, args=(c,), daemon=True,
+                                     name="chain-result-rx").start()
+            except BaseException as e:  # noqa: BLE001 — surfaced in get()
+                merge.fail(e)
+
+        threading.Thread(target=acceptor, daemon=True,
+                         name="chain-result-accept").start()
+        return merge
+
+    def _recv_tensor_fanin(self) -> np.ndarray:
+        merge = self._ensure_result_merge()
+        kind, y = merge.get(timeout=self.timeout_s)
+        while kind == K_CTRL:
+            kind, y = merge.get(timeout=self.timeout_s)
+        if kind != K_TENSOR:
+            raise ConnectionError(
+                f"chain returned frame kind {kind!r} while results were "
+                f"still in flight (a stage replica died and cascaded "
+                f"END?)")
         return y
 
     def collect_trace(self, node_addrs: Sequence[str]) -> int:
@@ -772,50 +1172,130 @@ class ChainDispatcher:
         mid-stream can't mask the original failure with a secondary
         BrokenPipe/EOF from the teardown itself."""
         try:
-            if self._send_sock is not None:
+            if self._send_sock is not None or self._send_socks:
                 if self._tx_chan is not None:
                     # the END rides the ordered tx queue behind any
                     # trailing frames; close() joins the tx thread so it
                     # is on the wire before we wait for the cascaded echo
+                    # (a FanOutSender ENDs every replica channel)
                     self._tx_chan.close(timeout=min(10.0, self.timeout_s))
-                else:
+                elif self._send_sock is not None:
                     send_end(self._send_sock)
-                if self._res_conn is None:
-                    # nothing was ever received: still accept the last
-                    # node's dial-back so its cascaded END completes
-                    try:
-                        self._res_srv.settimeout(min(10.0, self.timeout_s))
-                        self._res_conn, _ = self._res_srv.accept()
-                        self._res_conn.settimeout(self.timeout_s)
-                    except OSError:
-                        pass
-                if self._res_conn is not None:
-                    # drain any leftover in-flight frames until the END
-                    # cascades through
+                if self.result_fan_in > 1:
+                    # drain the merge until all R replica dial-backs have
+                    # delivered their END (the acceptor keeps taking late
+                    # dial-backs — e.g. a replica whose only frame was
+                    # the cascaded END itself)
+                    merge = self._ensure_result_merge()
                     while True:
-                        if self._rx_chan is not None:
-                            kind, _ = self._rx_chan.get(
-                                timeout=self.timeout_s)
-                        else:
-                            kind, _ = recv_frame(self._res_conn)
+                        kind, _ = merge.get(timeout=self.timeout_s)
                         if kind == K_END:
                             break
-        except (OSError, ConnectionError, ValueError):
+                else:
+                    if self._res_conn is None:
+                        # nothing was ever received: still accept the last
+                        # node's dial-back so its cascaded END completes
+                        try:
+                            self._res_srv.settimeout(
+                                min(10.0, self.timeout_s))
+                            self._res_conn, _ = self._res_srv.accept()
+                            self._res_conn.settimeout(self.timeout_s)
+                        except OSError:
+                            pass
+                    if self._res_conn is not None:
+                        # drain any leftover in-flight frames until the
+                        # END cascades through
+                        while True:
+                            if self._rx_chan is not None:
+                                kind, _ = self._rx_chan.get(
+                                    timeout=self.timeout_s)
+                            else:
+                                kind, _ = recv_frame(self._res_conn)
+                            if kind == K_END:
+                                break
+        except (OSError, ConnectionError, ValueError, TimeoutError):
             pass  # teardown after failure: keep the root cause
         finally:
             if self._send_sock is not None:
                 self._send_sock.close()
+            for s in self._send_socks or []:
+                s.close()
             if self._res_conn is not None:
                 self._res_conn.close()
+            for c in getattr(self, "_res_conns", None) or []:
+                c.close()
             self._res_srv.close()
 
 
 def _free_ports(n: int) -> list[int]:
+    """Probe n free localhost ports.  Inherently racy (probe-then-close,
+    then the children bind): a concurrent process can steal a port in
+    the gap.  ``run_chain`` compensates by detecting children that died
+    with a bind failure and retrying the whole spawn on fresh ports —
+    the race is unavoidable without fd passing, the hang it used to
+    cause is not."""
     socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
     ports = [s.getsockname()[1] for s in socks]
     for s in socks:
         s.close()
     return ports
+
+
+#: substrings that identify a child that lost the ``_free_ports`` race
+_BIND_RACE_MARKS = ("Address already in use", "EADDRINUSE",
+                    "address is already in use")
+
+
+def _log_tail(lf, limit: int = 2000) -> str:
+    try:
+        lf.flush()
+        lf.seek(0)
+        return lf.read()[-limit:]
+    except (OSError, ValueError):
+        return "<log unavailable>"
+
+
+def _kill_procs(procs, *, grace_s: float = 5.0) -> None:
+    """Terminate every child NOW (SIGTERM, short grace, then SIGKILL) —
+    the hardened teardown: a node that died mid-deploy/mid-stream must
+    not leave its siblings (or replica processes) running."""
+    for pr in procs:
+        if pr.poll() is None:
+            try:
+                pr.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace_s
+    for pr in procs:
+        try:
+            pr.wait(timeout=max(0.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            pr.kill()
+    for pr in procs:
+        try:
+            pr.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _normalize_replicas(replicas, n: int) -> list[int]:
+    """``{stage: R}`` -> per-stage replica counts, validated: in range,
+    >= 1, and never two adjacent replicated stages (a replica cannot
+    restore another fan-out's sequence order)."""
+    r_of = [1] * n
+    for k, r in (replicas or {}).items():
+        k, r = int(k), int(r)
+        if not 0 <= k < n:
+            raise ValueError(f"replicas: stage {k} out of range 0..{n - 1}")
+        if r < 1:
+            raise ValueError(f"replicas: stage {k} count {r} must be >= 1")
+        r_of[k] = r
+    for k in range(n - 1):
+        if r_of[k] > 1 and r_of[k + 1] > 1:
+            raise ValueError(
+                f"replicas: stages {k} and {k + 1} are both replicated; "
+                f"adjacent replication is not supported")
+    return r_of
 
 
 def run_chain(stages: Sequence, params: dict[str, Any], inputs,
@@ -824,8 +1304,13 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               env: dict[str, str] | None = None,
               in_band: bool = False, overlap: bool = True,
               rx_depth: int | None = None, tx_depth: int | None = None,
-              inflight: int | None = None) -> list[np.ndarray]:
-    """Export, spawn one OS process per stage, stream, and tear down.
+              inflight: int | None = None,
+              replicas: dict[int, int] | None = None,
+              hop_codecs: Sequence[str] | None = None,
+              stats_out: list | None = None,
+              spawn_retries: int = 3,
+              on_spawn=None) -> list[np.ndarray]:
+    """Export, spawn one OS process per stage REPLICA, stream, tear down.
 
     The one-call analogue of the reference's whole deployment procedure
     (start N ``node.py`` processes, run the dispatcher, src/dispatcher.py:
@@ -837,6 +1322,24 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     with an ACK handshake — full control-plane parity with the reference.
     ``in_band=False`` pre-exports artifacts to a (shared) directory and
     passes paths on the command line.
+
+    ``replicas`` maps stage index -> R: stage k runs as R data-parallel
+    processes fed round-robin with sequence numbers and merged back in
+    order downstream (docs/TRANSPORT.md).  The same artifact deploys to
+    every replica.  Adjacent stages cannot both be replicated.
+    ``hop_codecs`` (len = num stages) sets each stage's OUTBOUND hop
+    codec individually (default: ``codec`` everywhere); the dispatcher ->
+    stage-0 hop always uses ``codec``.  ``stats_out`` (a list) receives
+    every node's ``stats`` reply — per replica, queried before teardown.
+
+    Children that exit with an address-in-use bind failure (the
+    ``_free_ports`` probe race) are detected and the whole spawn retries
+    on fresh ports, up to ``spawn_retries`` attempts; any other child
+    death surfaces that node's log tail in the raised error.  On ANY
+    failure every remaining child is terminated before the error
+    propagates — a mid-deploy crash cannot leak live replica processes.
+    ``on_spawn(procs)`` is a test/instrumentation hook called with the
+    freshly spawned ``subprocess.Popen`` list of each attempt.
 
     ``env`` overrides the child environment.  By default children are
     pinned to the CPU backend: a local chain is a topology demonstration,
@@ -851,11 +1354,19 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     if artifact_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="defer_chain_")
         artifact_dir = tmp.name
-    logs: list = []
     try:
         n = len(stages)
-        ports = _free_ports(n + 1)  # node listen ports + result port
-        result_port = ports[-1]
+        r_of = _normalize_replicas(replicas, n)
+        if any(r > 1 for r in r_of) and not overlap:
+            raise ValueError(
+                "replicas require the overlapped node loop "
+                "(drop overlap=False / --no-overlap)")
+        if hop_codecs is not None and len(hop_codecs) != n:
+            raise ValueError(
+                f"hop_codecs must have one entry per stage "
+                f"({n}), got {len(hop_codecs)}")
+        codec_of = list(hop_codecs) if hop_codecs is not None \
+            else [codec] * n
 
         child_env = dict(os.environ)
         if env is None:
@@ -868,71 +1379,207 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                         ("--inflight", inflight)):
             if v is not None:
                 tuning += [flag, str(v)]
-        if in_band:
-            argv_for = lambda i: [  # noqa: E731 — tiny per-node argv
-                sys.executable, "-m", "defer_tpu", "node",
-                "--listen", f"127.0.0.1:{ports[i]}"] + tuning
-        else:
+        paths = None
+        if not in_band:
             paths = export_pipeline(stages, params, artifact_dir,
                                     batch=batch)
-            argv_for = lambda i: [  # noqa: E731
-                sys.executable, "-m", "defer_tpu", "node",
-                "--artifact", paths[i],
-                "--listen", f"127.0.0.1:{ports[i]}",
-                "--next", (f"127.0.0.1:{ports[i + 1]}" if i + 1 < n
-                           else f"127.0.0.1:{result_port}"),
-                "--codec", codec] + tuning
 
-        procs = []
-        for i in range(n):
-            # log to files, not PIPEs: an undrained pipe fills and
-            # deadlocks a chatty child mid-chain
-            lf = open(os.path.join(artifact_dir, f"node_{i}.log"), "w+")
-            logs.append(lf)
-            procs.append(subprocess.Popen(
-                argv_for(i), env=child_env, stdout=lf,
-                stderr=subprocess.STDOUT))
+        last_exc: BaseException | None = None
+        for attempt in range(max(1, spawn_retries)):
+            try:
+                return _chain_attempt(
+                    stages, params, inputs, batch=batch, codec=codec,
+                    codec_of=codec_of, r_of=r_of, paths=paths,
+                    in_band=in_band, tuning=tuning, child_env=child_env,
+                    artifact_dir=artifact_dir, rx_depth=rx_depth,
+                    tx_depth=tx_depth, stats_out=stats_out,
+                    on_spawn=on_spawn)
+            except _BindRace as e:
+                last_exc = e
+                print(f"run_chain: bind race on attempt {attempt + 1} "
+                      f"({e}); retrying on fresh ports", file=sys.stderr,
+                      flush=True)
+        raise RuntimeError(
+            f"chain spawn lost the port race {spawn_retries} times: "
+            f"{last_exc}") from last_exc
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
 
-        disp = ChainDispatcher(f"127.0.0.1:{ports[0]}",
-                               listen=f"127.0.0.1:{result_port}",
-                               codec=codec,
-                               # the CLI depth flags tune BOTH ends: the
-                               # nodes (via argv) and the dispatcher's own
-                               # feed/drain channels
-                               tx_depth=tx_depth if tx_depth else 8,
-                               rx_depth=rx_depth if rx_depth else 8)
+
+class _BindRace(RuntimeError):
+    """A chain child lost the ``_free_ports`` probe race (bound port was
+    stolen before the child's bind) — the spawn should retry."""
+
+
+def _await_binds(procs, labels, logs, flat_addrs, *,
+                 timeout_s: float = 90.0) -> None:
+    """Block until every child REPORTS its bind (the ``listening on``
+    line ``cmd_node`` prints right after ``StageNode`` binds), or
+    diagnose the one that died trying: a bind-race death raises
+    :class:`_BindRace` (retryable), anything else a ``RuntimeError``
+    carrying that node's log tail.  This is what turns the old bare
+    180 s connect timeout into a fast, attributed failure.  The log line
+    (not a connect probe) is the signal on purpose: a stolen port still
+    ACCEPTS connections — from whoever stole it."""
+    deadline = time.monotonic() + timeout_s
+    for i, addr in enumerate(flat_addrs):
+        while True:
+            rc = procs[i].poll()
+            tail = _log_tail(logs[i], limit=4000)
+            if "listening on" in tail:
+                break
+            if rc is not None and rc != 0:
+                if any(m in tail for m in _BIND_RACE_MARKS):
+                    raise _BindRace(
+                        f"node {labels[i]} lost the port bind race")
+                raise RuntimeError(
+                    f"chain node {labels[i]} exited rc={rc} during "
+                    f"boot: {tail[-2000:]}")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"chain node {labels[i]} did not bind {addr} "
+                    f"within {timeout_s:.0f}s: {tail[-2000:]}")
+            time.sleep(0.1)
+
+
+def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
+                   r_of, paths, in_band, tuning, child_env, artifact_dir,
+                   rx_depth, tx_depth, stats_out, on_spawn):
+    """One spawn -> deploy -> stream -> teardown attempt (see
+    ``run_chain``).  Raises :class:`_BindRace` when a child died with an
+    address-in-use failure; any other failure surfaces the dead node's
+    log tail after every remaining child has been terminated."""
+    n = len(stages)
+    total = sum(r_of)
+    ports = _free_ports(total + 1)  # per-replica listen ports + result
+    result_port = ports[-1]
+    # stage k's replica ports, in spawn order
+    addrs: list[list[str]] = []
+    labels: list[str] = []   # flat per-process labels for diagnostics
+    p = 0
+    for k in range(n):
+        addrs.append([f"127.0.0.1:{ports[p + j]}" for j in range(r_of[k])])
+        labels += ([f"stage{k}" if r_of[k] == 1 else f"stage{k}.r{j}"
+                    for j in range(r_of[k])])
+        p += r_of[k]
+
+    def argv_for(k: int, j: int) -> list[str]:
+        argv = [sys.executable, "-m", "defer_tpu", "node",
+                "--listen", addrs[k][j]]
+        if not in_band:
+            nxt = ",".join(addrs[k + 1]) if k + 1 < n \
+                else f"127.0.0.1:{result_port}"
+            argv += ["--artifact", paths[k], "--next", nxt,
+                     "--codec", codec_of[k]]
+            if k > 0 and r_of[k - 1] > 1:
+                argv += ["--fan-in", str(r_of[k - 1])]
+            if r_of[k] > 1:
+                argv += ["--replica", str(j)]
+        return argv + tuning
+
+    procs, logs = [], []
+    failure: BaseException | None = None
+    try:
+        for k in range(n):
+            for j in range(r_of[k]):
+                # log to files, not PIPEs: an undrained pipe fills and
+                # deadlocks a chatty child mid-chain
+                name = f"node_{k}" + (f"_r{j}" if r_of[k] > 1 else "")
+                lf = open(os.path.join(artifact_dir, f"{name}.log"), "w+")
+                logs.append(lf)
+                procs.append(subprocess.Popen(
+                    argv_for(k, j), env=child_env, stdout=lf,
+                    stderr=subprocess.STDOUT))
+        if on_spawn is not None:
+            on_spawn(procs)
+        flat = [a for group in addrs for a in group]
+        _await_binds(procs, labels, logs, flat)
+
+        try:
+            disp = ChainDispatcher(",".join(addrs[0]),
+                                   listen=f"127.0.0.1:{result_port}",
+                                   codec=codec,
+                                   # the CLI depth flags tune BOTH ends:
+                                   # the nodes (via argv) and the
+                                   # dispatcher's own feed/drain channels
+                                   tx_depth=tx_depth if tx_depth else 8,
+                                   rx_depth=rx_depth if rx_depth else 8,
+                                   result_fan_in=r_of[-1])
+        except OSError as e:
+            import errno
+            if getattr(e, "errno", None) == errno.EADDRINUSE \
+                    or any(m in str(e) for m in _BIND_RACE_MARKS):
+                # the PARENT's result-port bind lost the probe race —
+                # just as retryable as a child's
+                raise _BindRace(
+                    f"dispatcher lost the result-port bind race "
+                    f"({e})") from e
+            raise
+        flat_addrs = flat
         try:
             if in_band:
-                disp.deploy(stages, params,
-                            [f"127.0.0.1:{p}" for p in ports[:-1]],
-                            batch=batch)
+                disp.deploy(stages, params, addrs, batch=batch,
+                            codecs=codec_of)
             outs = disp.stream(inputs)
+            if stats_out is not None:
+                # per-replica observability, queried while the nodes are
+                # still serving (they exit once close() cascades END)
+                stats_out.extend(disp.stats(flat_addrs))
             if tracer().enabled:
                 # stitch every stage process's spans into this process's
-                # tracer while the nodes are still serving (they exit
-                # once close() cascades the END)
+                # tracer while the nodes are still serving
                 try:
-                    disp.collect_trace(
-                        [f"127.0.0.1:{p}" for p in ports[:-1]])
+                    disp.collect_trace(flat_addrs)
                 except (OSError, ConnectionError) as e:
                     print(f"run_chain: trace collection failed: {e!r}",
                           file=sys.stderr)
+        except BaseException as e:
+            failure = e
+            raise
         finally:
+            if failure is not None:
+                # hardened teardown: kill the children FIRST so the
+                # dispatcher's drain hits dead sockets (fast) instead of
+                # waiting out its timeouts against a wedged chain — and
+                # so a mid-deploy crash cannot leak live replicas
+                _kill_procs(procs)
             disp.close()
-            for pr in procs:
-                try:
-                    pr.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    pr.kill()
+            if failure is None:
+                for pr in procs:
+                    try:
+                        pr.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pr.kill()
         for i, pr in enumerate(procs):
             if pr.returncode not in (0, None):
-                logs[i].seek(0)
                 raise RuntimeError(
-                    f"stage node {i} exited rc={pr.returncode}: "
-                    f"{logs[i].read()[-2000:]}")
+                    f"chain node {labels[i]} exited rc={pr.returncode}: "
+                    f"{_log_tail(logs[i])}")
         return outs
+    except _BindRace:
+        _kill_procs(procs)
+        raise
+    except BaseException as e:
+        # diagnose: which children died, and why — surfacing each dead
+        # node's log tail instead of the dispatcher's bare timeout
+        _kill_procs(procs)
+        dead = [(labels[i], pr.returncode, _log_tail(logs[i]))
+                for i, pr in enumerate(procs)
+                if pr.returncode not in (0, None)]
+        races = [d for d in dead
+                 if any(m in d[2] for m in _BIND_RACE_MARKS)]
+        if races and all(d in races for d in dead):
+            raise _BindRace(
+                f"{[d[0] for d in races]} lost the port bind race") from e
+        if dead and not isinstance(e, RuntimeError):
+            detail = "; ".join(
+                f"node {lbl} rc={rc}: ...{tail[-800:]}"
+                for lbl, rc, tail in dead)
+            raise RuntimeError(
+                f"chain failed ({type(e).__name__}: {e}); dead nodes: "
+                f"{detail}") from e
+        raise
     finally:
         for lf in logs:
             lf.close()
-        if tmp is not None:
-            tmp.cleanup()

@@ -1,3 +1,5 @@
 from .channel import AsyncReceiver, AsyncSender, ChannelError
-from .framed import (K_BYTES, K_END, K_TENSOR, TensorClient, TensorServer,
-                     configure_socket, recv_frame, send_end, send_frame)
+from .framed import (K_BYTES, K_END, K_TENSOR, K_TENSOR_SEQ, TensorClient,
+                     TensorServer, configure_socket, recv_frame, send_end,
+                     send_frame)
+from .replicate import FanInMerge, FanOutSender

@@ -41,7 +41,7 @@ from .framed import K_END, recv_frame, send_ctrl, send_end, send_frame
 #: rx-queue sentinel: the thread died, ``err`` holds why
 _ERR = object()
 #: tx-queue item kinds
-_TENSOR, _CTRL, _END, _FLUSH = 0, 1, 2, 3
+_TENSOR, _CTRL, _END, _FLUSH, _TENSOR_SEQ = 0, 1, 2, 3, 4
 
 
 class ChannelError(ConnectionError):
@@ -169,10 +169,15 @@ class AsyncSender:
 
     # -- producer side -----------------------------------------------------
 
-    def send(self, arr) -> None:
+    def send(self, arr, *, seq: int | None = None) -> None:
         """Enqueue one tensor frame (encode + send happen on the tx
-        thread, under this sender's codec)."""
-        self._put((_TENSOR, arr))
+        thread, under this sender's codec).  ``seq`` stamps the frame
+        with a stream sequence number (``K_TENSOR_SEQ``) so a downstream
+        fan-in can restore order across parallel replica paths."""
+        if seq is None:
+            self._put((_TENSOR, arr))
+        else:
+            self._put((_TENSOR_SEQ, (seq, arr)))
 
     def send_ctrl(self, msg: dict) -> None:
         self._put((_CTRL, msg))
@@ -236,14 +241,19 @@ class AsyncSender:
                 t0 = time.perf_counter()
                 if kind == _TENSOR:
                     send_frame(self._sock, v, codec=self.codec)
+                elif kind == _TENSOR_SEQ:
+                    send_frame(self._sock, v[1], codec=self.codec,
+                               seq=v[0])
                 elif kind == _CTRL:
                     send_ctrl(self._sock, v)
                 else:
                     send_end(self._sock)
                 tr = tracer()
-                if tr.enabled and self._span is not None and kind == _TENSOR:
+                if tr.enabled and self._span is not None \
+                        and kind in (_TENSOR, _TENSOR_SEQ):
                     tr.record(f"{self._span()}.tx", t0,
-                              time.perf_counter() - t0, {"seq": n})
+                              time.perf_counter() - t0,
+                              {"seq": v[0] if kind == _TENSOR_SEQ else n})
                 n += 1
                 if kind == _END:
                     # release any flush marker enqueued after the END so

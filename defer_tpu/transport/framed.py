@@ -96,6 +96,15 @@ K_BYTES = 2
 K_END = 3
 K_CTRL = 4   # JSON control message (deploy/reweight handshake)
 K_ACK = 5    # the reference's 1-byte \x06 ACK (src/node.py:42), framed
+K_TENSOR_SEQ = 6  # v2: K_TENSOR + a u64 sequence number after the header
+
+#: wire protocol version.  v2 adds K_TENSOR_SEQ: a tensor frame carrying
+#: a monotonically increasing stream sequence number (u64, big-endian,
+#: between the fixed header and the codec name) so frames that travel
+#: parallel paths — data-parallel stage replicas — can be merged back
+#: into strict stream order at the fan-in (docs/TRANSPORT.md).  v1
+#: receivers reject kind 6 loudly; every other frame kind is unchanged.
+PROTOCOL_VERSION = 2
 
 _CODECS: dict[str, Codec] = {}
 #: creation lock: ``TensorClient.infer_stream`` decodes on a receiver
@@ -123,20 +132,32 @@ class _SleepCodec(Codec):
     the resource profile the rx/compute/tx overlap is built for.  The
     wire payload is byte-identical to the wrapped codec's.  Used by
     ``scripts/chain_overlap_smoke.py``; never pick it for deployments.
+
+    ``esleep<ms>+<codec>`` / ``dsleep<ms>+<codec>`` delay only the
+    encode / only the decode side — the one-sided variants let a bench
+    place the modeled non-CPU time on a *specific* process of a chain
+    (``scripts/replication_smoke.py`` makes one stage the bottleneck by
+    paying ``dsleep`` on its inbound hop and ``esleep`` on its outbound
+    hop, so the delay lands in the replicated stage's processes only).
     """
 
     name = "sleep"
 
-    def __init__(self, delay_s: float, inner: Codec):
+    def __init__(self, delay_s: float, inner: Codec, *,
+                 enc: bool = True, dec: bool = True):
         self._delay_s = delay_s
         self._inner = inner
+        self._enc = enc
+        self._dec = dec
 
     def encode(self, arr):
-        time.sleep(self._delay_s)
+        if self._enc:
+            time.sleep(self._delay_s)
         return self._inner.encode(arr)
 
     def decode(self, data, shape, dtype):
-        time.sleep(self._delay_s)
+        if self._dec:
+            time.sleep(self._delay_s)
         return self._inner.decode(data, shape, dtype)
 
 
@@ -150,6 +171,10 @@ def _make_codec(name: str) -> Codec:
     if name.startswith("sleep"):
         head, _, inner = name.partition("+")
         return _SleepCodec(float(head[5:]) / 1e3, _make_codec(inner or "raw"))
+    if name.startswith("esleep") or name.startswith("dsleep"):
+        head, _, inner = name.partition("+")
+        return _SleepCodec(float(head[6:]) / 1e3, _make_codec(inner or "raw"),
+                           enc=name[0] == "e", dec=name[0] == "d")
     raise ValueError(f"unknown codec {name!r}")
 
 
@@ -188,8 +213,13 @@ def _sendv(sock: socket.socket, *parts) -> None:
             views[0] = views[0][n:]
 
 
-def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
-    """Send one typed frame (tensor or raw bytes)."""
+def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw",
+               seq: int | None = None):
+    """Send one typed frame (tensor or raw bytes).
+
+    ``seq`` (tensor frames only) stamps the frame with a u64 stream
+    sequence number (kind ``K_TENSOR_SEQ``, protocol v2) so a fan-in
+    downstream of data-parallel replicas can restore stream order."""
     if isinstance(arr_or_bytes, (bytes, bytearray, memoryview)):
         kind, payload = K_BYTES, arr_or_bytes  # scatter-gather: no copy
         meta = b""
@@ -197,7 +227,7 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
         ndim = 0
     else:
         arr = np.asarray(arr_or_bytes)
-        kind = K_TENSOR
+        kind = K_TENSOR if seq is None else K_TENSOR_SEQ
         t0 = time.perf_counter()
         if codec == "raw":
             # zero-copy: the payload is a view of the array's own buffer
@@ -213,12 +243,15 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw"):
         dt = arr.dtype.str.encode()
         meta = dt + b"".join(struct.pack(">Q", s) for s in arr.shape)
         ndim = arr.ndim
-    dt_len = len(meta) - 8 * ndim if kind == K_TENSOR else 0
+    dt_len = len(meta) - 8 * ndim if kind != K_BYTES else 0
     plen = payload.nbytes if isinstance(payload, memoryview) else len(payload)
     hdr = _HDR.pack(kind, len(cname), dt_len, ndim, plen)
-    _sendv(sock, hdr + cname + meta, payload)
+    # v2: the sequence number rides between the fixed header and the
+    # codec name, so every later field keeps its v1 offset relative to it
+    pre = struct.pack(">Q", seq) if kind == K_TENSOR_SEQ else b""
+    _sendv(sock, hdr + pre + cname + meta, payload)
     _TX_FRAMES.n += 1
-    _TX_BYTES.n += _HDR.size + len(cname) + len(meta) + plen
+    _TX_BYTES.n += _HDR.size + len(pre) + len(cname) + len(meta) + plen
 
 
 def send_end(sock: socket.socket):
@@ -267,7 +300,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     """Receive one frame -> (kind, payload).  Tensor frames are decoded to
-    ndarrays; K_END returns (K_END, None)."""
+    ndarrays; K_END returns (K_END, None); K_TENSOR_SEQ (protocol v2)
+    returns (K_TENSOR_SEQ, (seq, ndarray))."""
     kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_into(sock, _HDR.size))
     _RX_FRAMES.n += 1
     _RX_BYTES.n += _HDR.size + clen + dlen + 8 * ndim + plen
@@ -280,6 +314,10 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     if kind == K_CTRL:
         import json as _json
         return K_CTRL, _json.loads(_recv_into(sock, plen).decode())
+    seq = None
+    if kind == K_TENSOR_SEQ:
+        seq = struct.unpack(">Q", _recv_into(sock, 8))[0]
+        _RX_BYTES.n += 8
     cname = _recv_into(sock, clen).decode()
     if kind == K_BYTES:
         return K_BYTES, _recv_exact(sock, plen)
@@ -295,6 +333,8 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
     else:
         value = _codec(cname).decode(memoryview(buf), shape, dt)
     _DEC_HIST.record(time.perf_counter() - t0)
+    if seq is not None:
+        return K_TENSOR_SEQ, (seq, value)
     return K_TENSOR, value
 
 

@@ -1,0 +1,233 @@
+"""Ordered fan-out / fan-in for data-parallel stage replicas.
+
+A pipeline's steady-state period is its slowest stage; when one
+indivisible stage dominates, no cut placement can fix it.  The hybrid
+answer (MPMD pipeline + GSPMD literature, PAPERS.md) is to run R
+data-parallel replicas of that stage *inside* the pipeline: the stage's
+effective service time drops to ``compute / R`` — provided the stream's
+order survives the parallel paths.  This module supplies the two order-
+preserving halves over the framed transport (protocol v2 sequence
+numbers, ``transport/framed.py``):
+
+* :class:`FanOutSender` — round-robins tensor frames across R
+  :class:`~defer_tpu.transport.channel.AsyncSender` channels, stamping
+  each frame with a monotonically increasing sequence number
+  (``K_TENSOR_SEQ``).  Strict round-robin means a stalled replica
+  eventually blocks the producer on that channel's turn — backpressure
+  is preserved per path, never routed around (which would starve the
+  fan-in of the stalled replica's sequence slots anyway).
+* :class:`FanInMerge` — a bounded reorder buffer fed by R upstream
+  reader threads, releasing frames to the consumer STRICTLY in sequence
+  order.  A gap (a replica running behind) parks the consumer even if
+  later frames are buffered; a full buffer parks the reader threads
+  (except for the frame the consumer is waiting on, which is always
+  admitted — liveness), which stops their socket reads, so TCP pushes
+  back on the fast replicas.  Frames are never silently reordered,
+  duplicated, or dropped: duplicate/stale sequence numbers raise.
+
+The merge ends when ALL R upstreams have delivered their END frame and
+the buffer has drained in order; an END with sequence gaps outstanding
+raises (a replica died mid-stream and its slots can never be filled).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Sequence
+
+from .channel import AsyncSender
+from .framed import K_CTRL, K_END, K_TENSOR
+
+__all__ = ["FanInMerge", "FanOutSender"]
+
+
+class FanInMerge:
+    """Bounded reorder buffer merging R sequence-stamped upstreams.
+
+    Reader threads (one per upstream connection) call :meth:`put` /
+    :meth:`put_ctrl` / :meth:`end` / :meth:`fail`; one consumer calls
+    :meth:`get` and receives ``(kind, value)`` tuples shaped like
+    ``recv_frame``'s: tensors strictly in sequence order (seq stripped),
+    control frames ahead of buffered tensors, then ``(K_END, None)``
+    once every upstream ended and the buffer drained.
+    """
+
+    def __init__(self, expected: int, *, capacity: int = 32):
+        if expected < 1:
+            raise ValueError(f"expected must be >= 1, got {expected}")
+        if capacity < max(expected, 1):
+            # fewer slots than upstreams could park every reader with the
+            # needed frame still in a socket nobody is reading
+            raise ValueError(f"capacity {capacity} < expected {expected}")
+        self.expected = expected
+        self.capacity = capacity
+        self._buf: dict[int, object] = {}
+        self._ctrl: list[dict] = []
+        self._next = 0
+        self._ends = 0
+        self._err: BaseException | None = None
+        self._cv = threading.Condition()
+
+    # -- producer side (reader threads) -------------------------------------
+
+    def put(self, seq: int, value, timeout: float | None = None) -> None:
+        """Insert one tensor by sequence number; blocks while the buffer
+        is full UNLESS ``seq`` is the one the consumer is parked on (the
+        needed frame is always admitted, so a full buffer of future
+        frames can never deadlock the stream).  Duplicate or stale
+        sequence numbers raise ``ValueError``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._err is not None:
+                    raise self._err
+                if seq < self._next or seq in self._buf:
+                    raise ValueError(
+                        f"duplicate/stale sequence {seq} "
+                        f"(next expected {self._next})")
+                if seq == self._next or len(self._buf) < self.capacity:
+                    self._buf[seq] = value
+                    self._cv.notify_all()
+                    return
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"reorder buffer full ({self.capacity}) for "
+                        f"{timeout:.1f}s waiting on seq {self._next}")
+                self._cv.wait(0.05)
+
+    def put_ctrl(self, msg: dict) -> None:
+        """Queue a control frame — delivered to the consumer ahead of
+        buffered tensors (control plane rides ahead of data, matching
+        the single-path trace-context convention)."""
+        with self._cv:
+            self._ctrl.append(msg)
+            self._cv.notify_all()
+
+    def end(self) -> None:
+        """One upstream delivered its END frame."""
+        with self._cv:
+            self._ends += 1
+            if self._ends > self.expected:
+                self._err = ConnectionError(
+                    f"{self._ends} END frames from {self.expected} "
+                    f"upstreams")
+            self._cv.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        """An upstream reader died: surface ``exc`` to everyone parked
+        here (consumer and other readers alike)."""
+        with self._cv:
+            if self._err is None:
+                self._err = exc
+            self._cv.notify_all()
+
+    # -- consumer side -------------------------------------------------------
+
+    def _pop_locked(self):
+        """One ready item under the lock, or None."""
+        if self._ctrl:
+            return K_CTRL, self._ctrl.pop(0)
+        if self._next in self._buf:
+            value = self._buf.pop(self._next)
+            self._next += 1
+            self._cv.notify_all()  # wake readers parked on a full buffer
+            return K_TENSOR, value
+        if self._err is not None:
+            raise self._err
+        if self._ends >= self.expected:
+            if self._buf:
+                raise ConnectionError(
+                    f"all {self.expected} upstreams ended with sequence "
+                    f"gap: waiting on {self._next}, "
+                    f"{sorted(self._buf)[:4]}... still buffered")
+            return K_END, None
+        return None
+
+    def get(self, timeout: float | None = None) -> tuple:
+        """Next in-order ``(kind, value)``; TimeoutError past ``timeout``
+        (None = wait forever), re-raises any reader's failure."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                got = self._pop_locked()
+                if got is not None:
+                    return got
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no in-order frame within {timeout:.1f}s "
+                        f"(waiting on seq {self._next}, "
+                        f"{len(self._buf)} out-of-order buffered)")
+                self._cv.wait(0.05)
+
+    def get_nowait(self) -> tuple:
+        """Non-blocking :meth:`get`; raises ``queue.Empty`` when the
+        next in-sequence frame has not arrived (even if later frames are
+        buffered — the consumer's cue to drain its compute window)."""
+        with self._cv:
+            got = self._pop_locked()
+        if got is None:
+            raise queue.Empty
+        return got
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._buf)
+
+
+class FanOutSender:
+    """Round-robin tensor distribution across R replica channels.
+
+    Presents the :class:`AsyncSender` surface (``send`` / ``send_ctrl``
+    / ``send_end`` / ``close``) over R of them: tensor ``i`` goes to
+    channel ``i % R`` stamped with sequence number ``i``; control and
+    END frames broadcast to every channel (each replica needs the trace
+    context, and the fan-in counts R ENDs).  ``send`` ignores a caller-
+    supplied seq and stamps its own — a fan-out begins a fresh sequence
+    segment (any upstream merge already restored order).
+    """
+
+    def __init__(self, socks: Sequence, *, depth: int = 8,
+                 codec: str = "raw", gauge: str | None = None, span=None):
+        if not socks:
+            raise ValueError("FanOutSender needs at least one socket")
+        self._chans = [AsyncSender(s, depth=depth, codec=codec,
+                                   gauge=gauge, span=span) for s in socks]
+        self._n = 0
+
+    @property
+    def width(self) -> int:
+        return len(self._chans)
+
+    def send(self, arr, *, seq: int | None = None) -> None:
+        self._chans[self._n % len(self._chans)].send(arr, seq=self._n)
+        self._n += 1
+
+    def send_ctrl(self, msg: dict) -> None:
+        for ch in self._chans:
+            ch.send_ctrl(msg)
+
+    def send_end(self) -> None:
+        for ch in self._chans:
+            ch.send_end()
+
+    def close(self, timeout: float | None = None) -> None:
+        """END every channel, then join them all; the first failure is
+        raised after every channel got its close attempt."""
+        first: BaseException | None = None
+        for ch in self._chans:
+            try:
+                ch.close(timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first is None:
+                    first = e
+        if first is not None:
+            raise first
+
+    def flush(self, timeout: float | None = None) -> None:
+        for ch in self._chans:
+            ch.flush(timeout=timeout)
+
+    def qsize(self) -> int:
+        return sum(ch.qsize() for ch in self._chans)

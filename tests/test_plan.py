@@ -12,8 +12,9 @@ from defer_tpu.graph.analysis import (auto_cut_points, max_activation_bytes,
                                       max_activation_elems,
                                       valid_cut_points)
 from defer_tpu.plan import (CodecSpec, StageCostModel, brute_force,
-                            evaluate_cuts, measured_stage_seconds, replan,
-                            solve, sweep_stages)
+                            brute_force_replicated, evaluate_cuts,
+                            measured_stage_seconds, replan, solve,
+                            solve_replicated, sweep_nodes, sweep_stages)
 
 
 def dense_chain(widths, name="chain", in_width=8):
@@ -107,6 +108,151 @@ def test_solver_errors():
     with pytest.raises(ValueError, match="objective"):
         auto_cut_points(g, 2, objective="nope")
     assert solve(g, 1, cm).cuts == []
+
+
+# -- hybrid replication solver -----------------------------------------------
+
+
+def test_replicated_dp_matches_brute_force_property():
+    """solve_replicated must equal exhaustive (cuts x replica-counts)
+    enumeration on random small graphs, for every node budget — and
+    never lose to the best cuts-only plan under the same budget."""
+    rng = random.Random(11)
+    checked = 0
+    for t in range(10):
+        g = random_graph(rng, t + 100)
+        C = len(valid_cut_points(g))
+        cm = StageCostModel(
+            g, batch=rng.choice([1, 4]), gen="v4",
+            link_bw_s=rng.choice([1e5, 1e7, 1e9]))
+        for N in (2, 3, 4, 5):
+            rp = solve_replicated(g, cm, num_nodes=N)
+            bf = brute_force_replicated(g, cm, num_nodes=N)
+            tol = 1e-12 + 1e-6 * bf.bottleneck_s
+            assert abs(rp.bottleneck_s - bf.bottleneck_s) <= tol, \
+                (t, N, rp.bottleneck_s, bf.bottleneck_s, rp.replicas)
+            assert rp.num_nodes == sum(rp.replicas) <= N
+            assert len(rp.replicas) == rp.num_stages
+            assert not any(rp.replicas[k] > 1 and rp.replicas[k + 1] > 1
+                           for k in range(rp.num_stages - 1))
+            # the hybrid never loses to cuts-only on the same model
+            cuts_only = min(
+                solve(g, S, cm).bottleneck_s
+                for S in range(1, min(N, C + 1) + 1))
+            assert rp.bottleneck_s <= cuts_only * (1 + 1e-9), \
+                (t, N, rp.bottleneck_s, cuts_only)
+            checked += 1
+    assert checked >= 30
+
+
+def test_replication_splits_indivisible_fat_stage():
+    """One node 10x heavier than the rest with nowhere left to cut:
+    cuts-only plateaus at the fat node's cost, the hybrid halves it by
+    replicating the stage that contains it."""
+    g = dense_chain([16, 16, 16], in_width=16)
+    costs = {n: 1e-5 for n in g.topo_order}
+    costs[g.topo_order[1]] = 1e-3  # fc0 output feeds the fat fc1
+    free = {"raw": CodecSpec("raw", 1.0, 1e14, 1e14)}
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e13, codecs=free,
+                        node_costs=costs)
+    cuts_only = min(solve(g, S, cm).bottleneck_s for S in (1, 2, 3))
+    assert cuts_only >= 1e-3 * (1 - 1e-9)  # the fat node is a floor
+    rp = solve_replicated(g, cm, num_nodes=4)
+    assert rp.bottleneck_s < cuts_only / 1.9  # >= ~2x from replication
+    k = max(range(rp.num_stages), key=lambda i: rp.replicas[i])
+    assert rp.replicas[k] > 1
+    # JSON carries the replica layout
+    d = rp.to_json()
+    assert d["replicas"] == rp.replicas and d["num_nodes"] == rp.num_nodes
+    assert len(d["stage_effective_ms"]) == rp.num_stages
+    json.dumps(d)
+
+
+def test_replicated_comm_model_fan_parallelism():
+    """enc/r_up + wire + dec/r_down: replicating the upstream stage
+    parallelizes the hop's encode side only, the downstream its decode
+    side only, and the wire term never divides."""
+    spec = CodecSpec("x", ratio=2.0, encode_bytes_per_s=1e6,
+                     decode_bytes_per_s=2e6)
+    g = dense_chain([256, 16], in_width=16)
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e6, codecs={"x": spec})
+    raw = cm.cut_bytes("fc0")
+    enc, wire, dec = cm.comm_parts("fc0", "x")
+    assert enc == pytest.approx(raw / 1e6)
+    assert dec == pytest.approx(raw / 2e6)
+    assert wire == pytest.approx((raw / 2.0) / 1e6)
+    _, s = cm.best_codec_replicated("fc0", 2, 3)
+    assert s == pytest.approx(enc / 2 + wire + dec / 3)
+    # singleton case must collapse exactly to the old comm model
+    _, s1 = cm.best_codec_replicated("fc0", 1, 1)
+    assert s1 == pytest.approx(cm.comm_seconds("fc0", "x"))
+
+
+def test_evaluate_cuts_replicated_and_validation():
+    g = dense_chain([16, 16, 16])
+    cm = StageCostModel(g, gen="v4")
+    p = evaluate_cuts(g, ["fc0"], cm, replicas=[1, 2])
+    assert p.replicas == [1, 2] and p.num_nodes == 3
+    with pytest.raises(ValueError, match="replica counts"):
+        evaluate_cuts(g, ["fc0"], cm, replicas=[1, 2, 1])
+    with pytest.raises(ValueError, match="adjacent"):
+        evaluate_cuts(g, ["fc0", "fc1"], cm, replicas=[1, 2, 2])
+
+
+def test_sweep_nodes_recommendation():
+    g = dense_chain([16, 16, 16, 16])
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e9)
+    sw = sweep_nodes(g, cm, max_nodes=4)
+    bots = [p.bottleneck_s for p in sw["plans"]]
+    assert all(b2 <= b1 * (1 + 1e-9) for b1, b2 in zip(bots, bots[1:]))
+    sw2 = sweep_nodes(g, cm, max_nodes=4, latency_target_s=1e6)
+    assert sw2["target_met"] is True
+    assert sw2["recommended"].num_nodes == 1
+
+
+def test_replan_replicated_keeps_budget_and_moves_replicas():
+    """Telemetry shows a stage 10x slower than modeled: the replicated
+    replan must re-solve under the SAME node budget and shift replicas
+    toward the measured hotspot."""
+    g = dense_chain([64] * 6, in_width=64)
+    free = {"raw": CodecSpec("raw", 1.0, 1e15, 1e15)}
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e13, codecs=free)
+    plan = solve_replicated(g, cm, num_nodes=4)
+    order = g.topo_order
+    bounds = [0] + [order.index(c) + 1 for c in plan.cuts] + [len(order)]
+    snap = {}
+    for k in range(plan.num_stages):
+        names = order[bounds[k]:bounds[k + 1]]
+        factor = 10.0 if k == 0 else 1.0
+        snap[f"p.stage{k}.latency_s"] = {
+            "count": 8, "p50": cm.compute_seconds(names) * factor}
+    rp = replan(g, plan, snap, cm)
+    assert rp.corrections[0] == pytest.approx(10.0, rel=1e-6)
+    assert rp.new_plan.num_nodes <= plan.num_nodes
+    assert rp.new_plan.replicas is not None
+    assert rp.predicted_improvement >= 1.0
+    json.dumps(rp.to_json())
+
+
+def test_measured_stage_seconds_averages_replicas():
+    stats = [{"stage": 1, "replica": 0,
+              "infer_latency_s": {"count": 4, "p50": 0.4}},
+             {"stage": 1, "replica": 1,
+              "infer_latency_s": {"count": 4, "p50": 0.6}},
+             {"stage": 0, "infer_latency_s": {"count": 4, "p50": 0.1}}]
+    got = measured_stage_seconds(stats)
+    assert got == {0: pytest.approx(0.1), 1: pytest.approx(0.5)}
+
+
+def test_cli_plan_nodes_json(capsys):
+    from defer_tpu.cli import main
+    main(["plan", "--model", "resnet_tiny", "--nodes", "4",
+          "--link-bw", "1e8", "--json"])
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    p = d["plan"]
+    assert sum(p["replicas"]) == p["num_nodes"] <= 4
+    assert d["predicted_speedup_vs_cuts_only"] >= 1.0
+    assert d["cuts_only"]["bottleneck_ms"] >= p["bottleneck_ms"]
 
 
 # -- codec selection ---------------------------------------------------------
