@@ -270,14 +270,18 @@ def run_script_row(script_name: str):
 #: script-delegated rows: `chain_overlap` (multi-process localhost chain,
 #: overlapped vs serial node loop), `plan_vs_quantile` (bottleneck-
 #: solver cuts vs greedy quantile cuts, predicted + measured — the row
-#: reports how much the quantile baseline loses on the skewed chain) and
+#: reports how much the quantile baseline loses on the skewed chain),
 #: `stage_replication` (hybrid pipeline/data-parallel chain: R=2 replicas
 #: of a delay-bottlenecked stage vs the serial chain — byte-identical
-#: outputs, >= 1.5x measured throughput, solver tie-in)
+#: outputs, >= 1.5x measured throughput, solver tie-in) and
+#: `obs_overhead` (live observability plane: monitor rows converge to
+#: node stats, bottleneck + straggler + replan name the delay-bound
+#: stage, clock-aligned waterfalls, telemetry wall overhead < 5%)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
     "stage_replication": "replication_smoke.py",
+    "obs_overhead": "monitor_smoke.py",
 }
 
 

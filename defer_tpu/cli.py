@@ -15,6 +15,10 @@ deployment from one controller:
   node       run one standalone stage node (recv -> stage -> relay), the
              working equivalent of the reference's ``python node.py``
   chain      export + spawn N local node processes + stream + verify
+  monitor    live top-style view of a running chain: subscribe to every
+             node's obs_push telemetry, aggregate per stage/replica,
+             highlight the bottleneck, flag stragglers
+             (docs/OBSERVABILITY.md)
 """
 
 from __future__ import annotations
@@ -433,11 +437,24 @@ def _apply_sock_buf(args, *, auto_bytes: int | None = None):
         os.environ["DEFER_SOCK_RCVBUF"] = str(buf)
 
 
+def _start_prom(args, who: str):
+    """``--prom-port N``: serve the process registry's Prometheus
+    exposition over stdlib HTTP (0 = ephemeral port, printed)."""
+    if getattr(args, "prom_port", None) is None:
+        return
+    from .obs.report import start_prom_server
+    srv = start_prom_server(args.prom_port)
+    print(f"{who}: prometheus exposition on "
+          f"http://127.0.0.1:{srv.server_address[1]}/metrics",
+          file=sys.stderr, flush=True)
+
+
 def cmd_node(args):
     from .runtime.node import StageNode
     from .transport.framed import _codec
 
     _apply_sock_buf(args)
+    _start_prom(args, "node")
     _codec(args.codec)  # loud at boot, not when the first tensor relays
     node = StageNode(args.artifact, args.listen, args.next,
                      codec=args.codec, overlap=not args.no_overlap,
@@ -506,13 +523,15 @@ def cmd_chain(args):
           .astype(np.float32) for _ in range(args.count)]
 
     replicas = _parse_replicas(args.replicas)
+    _start_prom(args, "chain")
     stats: list = []
     t0 = time.perf_counter()
     outs = run_chain(stages, params, xs, batch=args.batch, codec=args.codec,
                      in_band=args.in_band, overlap=not args.no_overlap,
                      rx_depth=args.rx_depth, tx_depth=args.tx_depth,
                      inflight=args.inflight, replicas=replicas or None,
-                     stats_out=stats)
+                     stats_out=stats,
+                     trace_sample_every=args.trace_sample)
     dt = time.perf_counter() - t0
 
     fwd = jax.jit(graph.apply)
@@ -535,6 +554,120 @@ def cmd_chain(args):
              "processed": s.get("processed")} for s in stats]
     print(json.dumps(row))
     _obs_finish(args)
+
+
+def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
+    """One refresh of the top-style monitor table (human mode)."""
+    tty = sys.stdout.isatty()
+    if clear and tty:
+        print("\x1b[2J\x1b[H", end="")
+    print(f"{'STAGE':>5} {'REP':>3} {'INF/S':>8} {'P50MS':>9} "
+          f"{'P95MS':>9} {'P99MS':>9} {'RXQ':>4} {'TXQ':>4} "
+          f"{'RX^':>4} {'TX^':>4} {'INF':>4} {'RX B/S':>11} "
+          f"{'TX B/S':>11} {'DONE':>8}  ADDR")
+    for r in rows:
+        stage = "-" if r["stage"] is None else str(r["stage"])
+        rep = "-" if r["replica"] is None else str(r["replica"])
+        p = r["infer_ms"]
+        line = (f"{stage:>5} {rep:>3} {r['throughput_per_s']:>8.1f} "
+                f"{p['p50']:>9.3f} {p['p95']:>9.3f} {p['p99']:>9.3f} "
+                f"{r['rx_q']:>4.0f} {r['tx_q']:>4.0f} "
+                f"{r['rx_hi']:>4.0f} {r['tx_hi']:>4.0f} "
+                f"{r['inflight']:>4.0f} {r['rx_bytes_per_s']:>11.0f} "
+                f"{r['tx_bytes_per_s']:>11.0f} {r['processed']:>8}  "
+                f"{r['addr'] or ''}")
+        mark = (bottleneck is not None and r["stage"] == bottleneck)
+        if not r["alive"]:
+            line += "  [DEAD]"
+        if mark:
+            line = f"\x1b[7m{line}\x1b[0m" if tty \
+                else line + "  <- bottleneck"
+        print(line)
+    for f in flags:
+        print(f"straggler: stage {f.stage} [{f.reason}] measured "
+              f"{f.measured_ms:.3f} ms vs planned {f.expected_ms:.3f} ms "
+              f"(x{f.ratio:.2f}, {f.intervals} intervals)")
+    if offsets:
+        worst = max(abs(v["offset_us"]) for v in offsets.values())
+        print(f"clock: {len(offsets)} nodes aligned "
+              f"(worst offset {worst / 1e3:.3f} ms)")
+    sys.stdout.flush()
+
+
+def cmd_monitor(args):
+    """Live chain observability: subscribe to every node's obs_push
+    stream (passively estimating each node's clock offset; --align to
+    actively re-anchor), render a refreshing per-stage/per-replica
+    table with the bottleneck stage highlighted — or --json lines for
+    machine consumption.  With --plan
+    (a ``plan --json`` file) the straggler detector compares live
+    service estimates against the plan and, when --model is also given,
+    a flagged stage triggers a replan suggestion."""
+    from .obs.cluster import (ClusterView, StragglerDetector,
+                              expected_stage_ms)
+
+    addrs = [a for a in args.nodes.split(",") if a]
+    if not addrs:
+        raise SystemExit("monitor requires --nodes host:port[,...]")
+    detector = plan = graph = None
+    if args.plan:
+        from .plan import plan_from_json
+        with open(args.plan) as f:
+            plan = plan_from_json(json.load(f))
+        detector = StragglerDetector(expected_stage_ms(plan),
+                                     factor=args.factor,
+                                     sustain=args.sustain)
+        if args.model:
+            graph = _get_model(args.model)
+    view = ClusterView()
+    view.connect(addrs, interval_ms=args.interval_ms,
+                 align_clocks=args.align,
+                 timeout_s=args.connect_timeout)
+    try:
+        i = 0
+        while True:
+            time.sleep(args.interval_ms / 1e3)
+            i += 1
+            rows = view.rows()
+            bott = view.bottleneck()
+            flags = detector.observe(view) if detector is not None else []
+            suggestion = err = None
+            if flags and graph is not None:
+                try:
+                    suggestion = detector.suggest(view, graph, plan)
+                except Exception as e:  # noqa: BLE001 — advisory
+                    err = repr(e)
+            if args.json:
+                doc = {"iteration": i, "bottleneck": bott, "rows": rows,
+                       "stragglers": [f.to_json() for f in flags],
+                       "clock_offsets": {
+                           a: round(v["offset_us"], 1)
+                           for a, v in view.clock_offsets.items()}}
+                if suggestion is not None:
+                    doc["replan"] = suggestion.to_json()
+                elif err is not None:
+                    doc["replan_error"] = err
+                print(json.dumps(doc), flush=True)
+            else:
+                _render_monitor(rows, bott, flags, view.clock_offsets,
+                                clear=i > 1)
+                if suggestion is not None:
+                    s = suggestion
+                    print(f"replan: moved={s.moved} predicted "
+                          f"improvement {s.predicted_improvement:.2f}x "
+                          f"(new cuts {','.join(s.new_plan.cuts) or '-'}"
+                          + (f", replicas "
+                             f"{getattr(s.new_plan, 'replicas', None)}"
+                             if getattr(s.new_plan, "replicas", None)
+                             else "") + ")")
+                elif err is not None:
+                    print(f"replan failed: {err}")
+            if args.iterations and i >= args.iterations:
+                return
+    except KeyboardInterrupt:
+        pass
+    finally:
+        view.close()
 
 
 def cmd_train(args):
@@ -728,6 +861,10 @@ def main(argv=None):
     nd.add_argument("--replica", type=int, default=None, metavar="N",
                     help="this process is replica N of its stage "
                          "(labels stageK.rN spans/stats)")
+    nd.add_argument("--prom-port", type=int, default=None, metavar="PORT",
+                    help="serve this process's metrics registry as a "
+                         "Prometheus scrape endpoint on PORT "
+                         "(0 = ephemeral, printed to stderr)")
     _add_overlap_flags(nd)
 
     c = sub.add_parser("chain", help="spawn a local N-process chain and "
@@ -750,8 +887,50 @@ def main(argv=None):
                    help="run stage K as R data-parallel replica "
                         "processes (ordered fan-out/fan-in; adjacent "
                         "stages cannot both be replicated)")
+    c.add_argument("--trace-sample", type=int, default=0, metavar="N",
+                   help="waterfall sampling: with --trace-out, stamp "
+                        "every frame with its stream sequence number "
+                        "and record per-frame spans (plus rx/tx queue-"
+                        "wait spans) for 1-in-N frames only")
+    c.add_argument("--prom-port", type=int, default=None, metavar="PORT",
+                   help="serve the dispatcher process's metrics "
+                        "registry as a Prometheus scrape endpoint")
     _add_overlap_flags(c)
     _add_obs_flags(c)
+
+    mo = sub.add_parser("monitor", help="live top-style view of a "
+                                        "running chain's obs_push "
+                                        "telemetry")
+    mo.add_argument("--nodes", required=True, metavar="host:port,...",
+                    help="the chain nodes' listen addresses (same list "
+                         "`stats`/deploy use)")
+    mo.add_argument("--interval-ms", type=float, default=500.0,
+                    help="push + refresh cadence (each node reports at "
+                         "this interval)")
+    mo.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="refresh N times then exit (0 = run until ^C)")
+    mo.add_argument("--json", action="store_true",
+                    help="one JSON line per refresh (rows, bottleneck, "
+                         "stragglers) instead of the table")
+    mo.add_argument("--plan", metavar="PLAN_JSON",
+                    help="a `plan --json` file: enables the straggler "
+                         "detector against the plan's per-stage "
+                         "expectations")
+    mo.add_argument("--model", default=None,
+                    help="with --plan: rebuild the layer graph so a "
+                         "flagged straggler emits a replan suggestion")
+    mo.add_argument("--factor", type=float, default=1.5,
+                    help="straggler threshold: live service estimate > "
+                         "factor x planned, sustained")
+    mo.add_argument("--sustain", type=int, default=2,
+                    help="reporting intervals a deviation must hold "
+                         "before it is flagged")
+    mo.add_argument("--align", action="store_true",
+                    help="actively clock-ALIGN every node's tracer to "
+                         "this process (default: passively estimate "
+                         "offsets only — an observer must not re-anchor "
+                         "spans the dispatcher already aligned)")
+    mo.add_argument("--connect-timeout", type=float, default=30.0)
 
     t = sub.add_parser("train", help="pipeline-parallel training demo "
                                      "(synthetic data, cross-entropy)")
@@ -793,7 +972,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     {"models": cmd_models, "partition": cmd_partition, "plan": cmd_plan,
      "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
-     "chain": cmd_chain, "train": cmd_train,
+     "chain": cmd_chain, "monitor": cmd_monitor, "train": cmd_train,
      "generate": cmd_generate}[args.cmd](args)
 
 

@@ -22,10 +22,17 @@ from .histogram import LatencyHistogram
 from .registry import REGISTRY, Counter, Gauge, MetricsRegistry, get_registry
 from .trace import (Tracer, enable_tracing, export_chrome_trace,
                     new_span_id, tracer, trace_context)
+from .cluster import (ClusterView, StragglerDetector, StragglerFlag,
+                      align_clock, estimate_clock_offset,
+                      expected_stage_ms)
+from .report import ObsReporter, start_prom_server
 
 __all__ = [
     "LatencyHistogram",
     "MetricsRegistry", "REGISTRY", "get_registry", "Counter", "Gauge",
     "Tracer", "tracer", "enable_tracing", "export_chrome_trace",
     "trace_context", "new_span_id",
+    "ClusterView", "StragglerDetector", "StragglerFlag",
+    "estimate_clock_offset", "align_clock", "expected_stage_ms",
+    "ObsReporter", "start_prom_server",
 ]

@@ -43,15 +43,45 @@ class Counter:
 
 
 class Gauge:
-    """Last-written value."""
+    """Last-written value, with additive updates and a high watermark.
 
-    __slots__ = ("v",)
+    ``set`` remains the single-writer spelling; ``inc``/``dec`` are the
+    MULTI-writer spelling — several channels bound to one gauge name
+    (e.g. the R senders of a fan-out all publishing
+    ``node.tx_queue_depth``) compose additively instead of clobbering
+    each other with absolute reads-then-sets.  Same atomicity contract
+    as :class:`Counter`: a GIL-level race costs one update, never a
+    corrupt value.  ``hi`` tracks the max value seen since the last
+    :meth:`take_watermark` — the queue-depth watermark an obs_push
+    reports per interval.
+    """
+
+    __slots__ = ("v", "hi")
 
     def __init__(self):
         self.v = 0.0
+        self.hi = 0.0
 
     def set(self, v: float) -> None:
         self.v = v
+        if v > self.hi:
+            self.hi = v
+
+    def inc(self, k: float = 1.0) -> None:
+        v = self.v + k
+        self.v = v
+        if v > self.hi:
+            self.hi = v
+
+    def dec(self, k: float = 1.0) -> None:
+        self.v -= k
+
+    def take_watermark(self) -> float:
+        """Max value since the previous call; resets to the current value
+        (so each reporting interval sees its own peak)."""
+        h = self.hi if self.hi > self.v else self.v
+        self.hi = self.v
+        return h
 
     @property
     def value(self) -> float:
@@ -62,8 +92,25 @@ class Gauge:
 
 
 def _prom_name(name: str) -> str:
-    """Dotted metric name -> Prometheus-legal name."""
-    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    """Dotted metric name -> Prometheus-legal name (``[a-zA-Z_:]`` first
+    char, ``[a-zA-Z0-9_:]`` after)."""
+    n = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not n or n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _prom_escape(text: str) -> str:
+    """Escape a HELP line per the Prometheus text format: backslash and
+    newline (HELP text is not quoted, so quotes pass through)."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _prom_label_value(text: str) -> str:
+    """Escape a label VALUE per the text format: backslash, double
+    quote, newline."""
+    return (text.replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
 
 
 class MetricsRegistry:
@@ -188,25 +235,39 @@ class MetricsRegistry:
         return out
 
     def exposition(self) -> str:
-        """Prometheus text format (histograms as summaries)."""
+        """Prometheus text format (histograms as summaries).
+
+        Hardened per the text-format spec: every family gets a ``# HELP``
+        line (carrying the original dotted name, escaped), metric names
+        are sanitized to the legal charset (never digit-first), and
+        label values are escaped — so a scraper / promtool never chokes
+        on a creatively-named instrument."""
         metrics = self._live_metrics()
         with self._lock:
             callbacks = dict(self._callbacks)
         lines: list[str] = []
-        for name, m in sorted(metrics.items()):
+
+        def family(name: str, kind: str) -> str:
             pn = _prom_name(name)
+            lines.append(f"# HELP {pn} defer_tpu metric "
+                         f"{_prom_escape(name)}")
+            lines.append(f"# TYPE {pn} {kind}")
+            return pn
+
+        for name, m in sorted(metrics.items()):
             if isinstance(m, LatencyHistogram):
-                lines.append(f"# TYPE {pn} summary")
+                pn = family(name, "summary")
                 for q in (0.5, 0.95, 0.99):
                     lines.append(
-                        f'{pn}{{quantile="{q}"}} {m.quantile(q):.9g}')
+                        f'{pn}{{quantile="{_prom_label_value(str(q))}"}} '
+                        f'{m.quantile(q):.9g}')
                 lines.append(f"{pn}_sum {m.sum:.9g}")
                 lines.append(f"{pn}_count {m.count}")
             elif isinstance(m, Counter):
-                lines.append(f"# TYPE {pn} counter")
+                pn = family(name, "counter")
                 lines.append(f"{pn} {m.value}")
             elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {pn} gauge")
+                pn = family(name, "gauge")
                 lines.append(f"{pn} {m.value:.9g}")
         for name, fn in sorted(callbacks.items()):
             try:
@@ -214,8 +275,7 @@ class MetricsRegistry:
             except Exception:  # noqa: BLE001 — skip dead callbacks
                 continue
             if isinstance(v, (int, float)) and not isinstance(v, bool):
-                pn = _prom_name(name)
-                lines.append(f"# TYPE {pn} gauge")
+                pn = family(name, "gauge")
                 lines.append(f"{pn} {v:.9g}" if isinstance(v, float)
                              else f"{pn} {v}")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,99 @@
+"""Node-side push telemetry: the obs reporter thread and the Prometheus
+scrape endpoint.
+
+A stage node answers ``{"cmd": "obs_subscribe", "interval_ms": 250}`` on
+any control connection by starting one :class:`ObsReporter` bound to that
+connection: a daemon thread that periodically builds an ``obs_push``
+control frame from the node's live state (``StageNode.obs_snapshot``)
+and writes it back on the same socket — no new ports, the push plane
+rides the existing K_CTRL channel.  The reporter is self-cleaning: the
+first failed send (subscriber closed the connection, node tearing down)
+ends the thread.
+
+:func:`start_prom_server` is the pull-side alternative: a stdlib
+``http.server`` endpoint serving ``MetricsRegistry.exposition()`` for a
+Prometheus scraper (``--prom-port`` on the ``node``/``chain`` CLIs).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .registry import REGISTRY
+from .trace import tracer
+
+
+class ObsReporter(threading.Thread):
+    """Per-subscription push thread (one per ``obs_subscribe``).
+
+    ``source`` supplies the payload: an object with
+    ``obs_snapshot(cursor, include_spans, span_limit) -> (dict, cursor)``
+    (``StageNode`` implements it).  The span cursor starts at the
+    subscription instant, so pushes carry only spans recorded since —
+    and never drain the buffer ``trace_dump`` collects at stream end.
+    """
+
+    def __init__(self, source, conn, *, interval_s: float = 0.25,
+                 spans: bool = True, span_limit: int = 256):
+        super().__init__(daemon=True, name="obs-reporter")
+        self._source = source
+        self._conn = conn
+        self.interval_s = max(0.02, float(interval_s))
+        self._spans = spans
+        self._span_limit = span_limit
+        # NOT named _stop: threading.Thread's own machinery calls
+        # self._stop() as a METHOD when a dead thread's is_alive() is
+        # checked — shadowing it with an Event breaks that call
+        self._halt = threading.Event()
+        self._cursor = tracer().span_cursor()
+
+    def run(self) -> None:
+        from ..transport.framed import send_ctrl
+        seq = 0
+        while not self._halt.is_set():
+            try:
+                payload, self._cursor = self._source.obs_snapshot(
+                    cursor=self._cursor, include_spans=self._spans,
+                    span_limit=self._span_limit)
+                payload["cmd"] = "obs_push"
+                payload["push_seq"] = seq
+                payload["interval_ms"] = round(self.interval_s * 1e3, 3)
+                payload["t_us"] = tracer().now_us()
+                send_ctrl(self._conn, payload)
+            except (OSError, ValueError):
+                return  # subscriber gone / socket closed: self-clean
+            seq += 1
+            self._halt.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def start_prom_server(port: int, *, host: str = "127.0.0.1",
+                      registry=None):
+    """Serve ``registry.exposition()`` at ``http://host:port/metrics``
+    (any path answers, as scrapers sometimes probe ``/``) on a daemon
+    thread.  Returns the ``ThreadingHTTPServer``; its actual bound port
+    is ``server.server_address[1]`` (pass ``port=0`` for an ephemeral
+    one).  Stdlib only — no prometheus_client dependency."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else REGISTRY
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            body = reg.exposition().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # noqa: ARG002 — silence stderr
+            pass
+
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="prom-http").start()
+    return srv

@@ -21,11 +21,18 @@ open the file at https://ui.perfetto.dev or chrome://tracing.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
 import time
 import uuid
+
+from .registry import REGISTRY
+
+#: incremented whenever a span is evicted from a full buffer — the
+#: visible price of the cap (docs/OBSERVABILITY.md, overhead notes)
+_DROPPED = REGISTRY.counter("trace.dropped_spans")
 
 
 def _new_id() -> str:
@@ -90,11 +97,27 @@ _NOOP = _NoopSpan()
 
 
 class Tracer:
-    def __init__(self, process: str | None = None, enabled: bool = False):
+    #: default span-buffer cap (spans, not bytes).  A long traced stream
+    #: must not grow memory without bound: past the cap the OLDEST span
+    #: is evicted per append and ``trace.dropped_spans`` counts the loss.
+    DEFAULT_MAX_SPANS = int(os.environ.get("DEFER_TRACE_MAX_SPANS",
+                                           "200000") or 200000)
+
+    def __init__(self, process: str | None = None, enabled: bool = False,
+                 max_spans: int | None = None):
         #: the one predicate hot paths check
         self.enabled = enabled
         self.process = process or f"pid{os.getpid()}"
-        self._spans: list[dict] = []
+        self._spans: collections.deque[dict] = collections.deque()
+        self.max_spans = (self.DEFAULT_MAX_SPANS if max_spans is None
+                          else int(max_spans))
+        #: spans evicted because the buffer was full (lifetime)
+        self.dropped = 0
+        #: spans ever removed from the FRONT of the buffer (drained,
+        #: cleared, or evicted) — the anchor of the ``spans_since``
+        #: cursor contract, so live subscribers can fetch incremental
+        #: batches without draining what ``trace_dump`` will collect
+        self._base = 0
         self._tls = threading.local()
         self._trace_id: str | None = None
         #: adopted remote parent (cross-process propagation target)
@@ -184,24 +207,107 @@ class Tracer:
             "tid": threading.get_ident() & 0xFFFF,
             "args": args or {},
         })
+        if len(self._spans) > self.max_spans:
+            self._evict(len(self._spans) - self.max_spans)
+
+    def _evict(self, n: int) -> None:
+        """Drop the ``n`` oldest spans (buffer cap): recent spans are the
+        ones a live monitor and an end-of-stream dump still want.  A
+        concurrent ``drain`` may empty the buffer between the length
+        check and the pop — losing the eviction race just means the
+        drain already made room."""
+        popped = 0
+        for _ in range(n):
+            try:
+                self._spans.popleft()
+            except IndexError:
+                break
+            popped += 1
+        self.dropped += popped
+        self._base += popped
+        _DROPPED.n += popped
+
+    # -- clock alignment ----------------------------------------------------
+
+    def now_us(self) -> int:
+        """This process's current position on the span timeline (the same
+        anchor ``_finish`` stamps ``ts_us`` with) — what a clock-offset
+        probe compares across processes."""
+        return self._wall0_us + int(
+            (time.perf_counter() - self._mono0) * 1e6)
+
+    def shift_wall_anchor(self, delta_us: int) -> None:
+        """Shift the wall anchor by ``delta_us`` — clock alignment after a
+        ping-pong offset estimate (``obs.cluster.estimate_clock_offset``).
+        Already-buffered spans shift too, so the whole dump stays on one
+        coherent axis no matter when the correction landed.
+
+        Iterates a snapshot (``list(deque)`` is atomic under the GIL, a
+        Python-level loop over the live deque is not): hot-path threads
+        may append WHILE the anchor shifts, and a span stamped with the
+        old anchor in that window stays unshifted — a one-span, one-time
+        telemetry error, vs. a RuntimeError that would kill the
+        connection worker applying a ``clock_adjust``."""
+        delta_us = int(delta_us)
+        self._wall0_us += delta_us
+        for s in list(self._spans):
+            s["ts_us"] += delta_us
 
     # -- cross-process stitching -------------------------------------------
 
     def drain(self) -> list[dict]:
-        """Pop all recorded spans (the ship-over-the-wire form)."""
-        spans, self._spans = self._spans, []
+        """Pop all recorded spans (the ship-over-the-wire form).
+
+        Element-wise popleft, not snapshot+clear: a span appended by a
+        concurrent hot-path thread mid-drain is either drained or left
+        for the next drain — never silently lost between the copy and
+        the clear."""
+        spans: list[dict] = []
+        while True:
+            try:
+                spans.append(self._spans.popleft())
+            except IndexError:
+                break
+        self._base += len(spans)
         return spans
 
     def ingest(self, spans: list[dict]) -> None:
         """Merge spans drained from another process's tracer."""
         self._spans.extend(spans)
+        if len(self._spans) > self.max_spans:
+            self._evict(len(self._spans) - self.max_spans)
+
+    def span_cursor(self) -> int:
+        """Monotone count of spans ever finished in this tracer — pass it
+        back to :meth:`spans_since` for an incremental batch."""
+        return self._base + len(self._spans)
+
+    def spans_since(self, cursor: int, limit: int | None = None
+                    ) -> tuple[int, list[dict]]:
+        """(new_cursor, spans finished after ``cursor``) WITHOUT draining:
+        a live subscriber (obs_push span batches) reads incrementally
+        while ``trace_dump`` still collects everything at stream end.
+        ``limit`` keeps only the newest N of the batch (push size bound);
+        spans evicted or drained before the read are simply gone.
+
+        Reads a snapshot first — ``list(deque)`` is GIL-atomic, whereas
+        islice over the live deque would raise if a hot-path thread
+        appended mid-iteration (the reporter thread calls this while
+        the stream is recording)."""
+        base = self._base
+        snapshot = list(self._spans)
+        start = max(0, cursor - base)
+        out = snapshot[start:]
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return base + len(snapshot), out
 
     @property
     def spans(self) -> list[dict]:
         return list(self._spans)
 
     def clear(self) -> None:
-        self._spans = []
+        self.drain()
 
     # -- export ------------------------------------------------------------
 
@@ -209,7 +315,7 @@ class Tracer:
         """Spans as Chrome trace-event dicts (complete events, ph="X")."""
         pids: dict[str, int] = {}
         events: list[dict] = []
-        for s in self._spans:
+        for s in list(self._spans):  # snapshot: appends may race export
             proc = s.get("proc", "?")
             pid = pids.get(proc)
             if pid is None:

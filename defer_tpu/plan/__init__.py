@@ -24,17 +24,18 @@ from .cost import (CodecSpec, DEFAULT_CODECS, StageCostModel,
                    bench_codec_instance, bench_codec_spec,
                    calibrate_codecs)
 from .replan import (ReplanResult, corrected_cost_model,
-                     measured_stage_seconds, replan)
+                     cost_model_from_plan, measured_stage_seconds, replan)
 from .solver import (Plan, ReplicatedPlan, brute_force,
-                     brute_force_replicated, evaluate_cuts, solve,
-                     solve_replicated, sweep_nodes, sweep_stages)
+                     brute_force_replicated, evaluate_cuts,
+                     plan_from_json, solve, solve_replicated,
+                     sweep_nodes, sweep_stages)
 
 __all__ = [
     "CodecSpec", "DEFAULT_CODECS", "StageCostModel",
     "bench_codec_instance", "bench_codec_spec", "calibrate_codecs",
     "Plan", "solve", "evaluate_cuts", "sweep_stages", "brute_force",
     "ReplicatedPlan", "solve_replicated", "brute_force_replicated",
-    "sweep_nodes",
+    "sweep_nodes", "plan_from_json",
     "ReplanResult", "replan", "measured_stage_seconds",
-    "corrected_cost_model",
+    "corrected_cost_model", "cost_model_from_plan",
 ]

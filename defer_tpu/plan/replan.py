@@ -39,9 +39,13 @@ def measured_stage_seconds(source, *, quantile: str = "p50",
                            scale: float = 1.0) -> dict[int, float]:
     """stage index -> measured seconds, from telemetry.
 
-    ``source`` is either a registry snapshot dict (histogram summaries
-    under ``...stage<k>.latency_s`` keys, seconds) or a list of node
-    ``stats`` dicts (``{"stage": k, "infer_latency_s": {...}}``).
+    ``source`` is a registry snapshot dict (histogram summaries under
+    ``...stage<k>.latency_s`` keys, seconds), a list of node ``stats``
+    dicts (``{"stage": k, "infer_latency_s": {...}}``), or a direct
+    ``{stage: seconds}`` mapping (e.g. a live
+    ``ClusterView.stage_service_ms()`` converted to seconds — the
+    full-service estimate, which unlike infer-only latency includes a
+    stage's per-hop codec costs).
     ``quantile`` picks the summary field (p50 by default — the
     steady-state number; mean is skewed by compile outliers).  ``scale``
     converts units if the source was exported scaled.
@@ -60,6 +64,15 @@ def measured_stage_seconds(source, *, quantile: str = "p50",
         if v is not None:
             acc.setdefault(int(stage), []).append(float(v) * scale)
 
+    if isinstance(source, dict) and source and all(
+            (isinstance(k, int) or (isinstance(k, str) and k.isdigit()))
+            and isinstance(v, (int, float)) and not isinstance(v, bool)
+            for k, v in source.items()):
+        # direct {stage: seconds} mapping: pass through (scaled).  Keys
+        # must LOOK like stage indices — an all-numeric registry
+        # snapshot (counters/gauges only) must fall through to the
+        # pattern search below and yield {}, not crash on int("a.b")
+        return {int(k): float(v) * scale for k, v in source.items()}
     if isinstance(source, dict):
         for key, summ in source.items():
             m = _STAGE_KEY.search(key)
@@ -111,6 +124,28 @@ class ReplanResult:
             "old_corrected": self.old_plan_corrected.to_json(),
             "new": self.new_plan.to_json(),
         }
+
+
+def cost_model_from_plan(graph: LayerGraph, plan: Plan) -> StageCostModel:
+    """A cost model whose per-stage compute totals reproduce the plan's
+    own ``stage_compute_s`` (spread uniformly over each stage's nodes).
+
+    The right default when replanning against a plan whose original
+    model is gone — a monitor that loaded plan JSON, or ``run_chain``'s
+    live straggler suggestion: per-stage correction factors
+    (measured / predicted) only need the stage TOTALS, which this model
+    matches exactly; the uniform spread inside a stage makes the
+    re-solve approximate, which a suggestion is anyway."""
+    order = graph.topo_order
+    pos = {n: i for i, n in enumerate(order)}
+    bounds = [0] + [pos[c] + 1 for c in plan.cuts] + [len(order)]
+    node_costs: dict[str, float] = {}
+    for k in range(len(bounds) - 1):
+        names = order[bounds[k]:bounds[k + 1]]
+        per = plan.stage_compute_s[k] / max(1, len(names))
+        for n in names:
+            node_costs[n] = per
+    return StageCostModel(graph, node_costs=node_costs)
 
 
 def corrected_cost_model(graph: LayerGraph, plan: Plan,

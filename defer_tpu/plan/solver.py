@@ -343,6 +343,28 @@ def brute_force(graph: LayerGraph, num_stages: int,
     return best_plan
 
 
+def plan_from_json(doc: dict) -> "Plan":
+    """Rebuild a :class:`Plan` / :class:`ReplicatedPlan` from its
+    ``to_json()`` dict (what ``defer_tpu plan --json`` prints) — so a
+    saved plan can seed telemetry replanning or the live monitor's
+    straggler detector without re-solving."""
+    doc = doc.get("plan", doc)  # accept a whole `plan --json` document
+    kw = dict(
+        graph_name=doc.get("graph", ""),
+        num_stages=int(doc["num_stages"]),
+        cuts=list(doc.get("cuts", [])),
+        codecs=list(doc.get("hop_codecs", [])),
+        stage_compute_s=[v / 1e3 for v in doc["stage_compute_ms"]],
+        hop_comm_s=[v / 1e3 for v in doc.get("hop_comm_ms", [])],
+        bottleneck_s=float(doc["bottleneck_ms"]) / 1e3,
+        objective=doc.get("objective", "explicit"),
+        cost=doc.get("cost_model", {}))
+    if doc.get("replicas"):
+        return ReplicatedPlan(**kw, replicas=list(doc["replicas"]),
+                              num_nodes=int(doc.get("num_nodes", 0)))
+    return Plan(**kw)
+
+
 # -- hybrid pipeline/data-parallel: cuts + per-stage replica counts ----------
 
 

@@ -38,30 +38,23 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from ..obs import REGISTRY, new_span_id, tracer
-from ..transport.channel import AsyncReceiver, AsyncSender
+from ..obs import REGISTRY, LatencyHistogram, new_span_id, tracer
+from ..obs.report import ObsReporter
+from ..transport.channel import AsyncReceiver, AsyncSender, _sampled
 from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
-                                K_TENSOR_SEQ, configure_socket, recv_expect,
-                                recv_frame, send_ack, send_ctrl, send_end,
-                                send_frame)
+                                K_TENSOR_SEQ, configure_socket,
+                                connect_retry, recv_expect, recv_frame,
+                                send_ack, send_ctrl, send_end, send_frame)
 from ..transport.replicate import FanInMerge, FanOutSender
 
 
 def _connect_retry(host: str, port: int, timeout_s: float = 30.0
                    ) -> socket.socket:
     """Connect, retrying while the peer boots (replaces the reference's
-    sleep-5 polling rendezvous, src/node.py:95-96)."""
-    deadline = time.monotonic() + timeout_s
-    delay = 0.05
-    while True:
-        try:
-            return configure_socket(
-                socket.create_connection((host, port), timeout=timeout_s))
-        except OSError:
-            if time.monotonic() >= deadline:
-                raise
-            time.sleep(delay)
-            delay = min(delay * 2, 1.0)
+    sleep-5 polling rendezvous, src/node.py:95-96).  The policy lives in
+    :func:`transport.framed.connect_retry`; this alias keeps the
+    historical call sites (and test monkeypatch points)."""
+    return connect_retry(host, port, timeout_s)
 
 
 def _parse_hostport(s: str, default_host: str = "127.0.0.1"
@@ -106,6 +99,18 @@ class StageNode:
     fan_in: int = 1
     replica: int | None = None
     next_hops: list[tuple[str, int]] | None = None
+    #: waterfall sampling period carried by the trace context (0 = every
+    #: frame records spans, N >= 1 = only wire-seq multiples of N)
+    trace_sample_every: int = 0
+    #: live data-path channels (set once a connection proves to be the
+    #: stream) — what obs_push reads queue depths/watermarks from
+    _live_rx = None
+    _live_tx = None
+    #: per-NODE infer histogram (None on ``__new__``-built stubs): the
+    #: registry's ``node.infer_s`` is process-wide, which in-process
+    #: thread chains share across nodes — this instance copy keeps
+    #: stats/obs_push attribution per node everywhere
+    infer_hist: LatencyHistogram | None = None
 
     def __init__(self, artifact: str | None, listen: str,
                  next_hop: str | None, *, codec: str = "raw",
@@ -141,6 +146,11 @@ class StageNode:
         self._merge: FanInMerge | None = None
         self._merge_lock = threading.Lock()
         self._done_q = None   # serve()'s completion queue (set per serve)
+        self._live_rx = None
+        self._live_tx = None
+        self.infer_hist = LatencyHistogram()
+        #: live obs_push reporter threads (one per subscription)
+        self._reporters: list[ObsReporter] = []
 
     @property
     def manifest(self):
@@ -179,13 +189,17 @@ class StageNode:
             tx = AsyncSender(socks[0], depth=self.tx_depth,
                              codec=self.codec,
                              gauge="node.tx_queue_depth",
-                             span=self._span_label)
+                             span=self._span_label,
+                             hist="node.tx_s")
         else:
             tx = FanOutSender(socks, depth=self.tx_depth,
                               codec=self.codec,
                               gauge="node.tx_queue_depth",
-                              span=self._span_label)
+                              span=self._span_label,
+                              hist="node.tx_s")
             tx.send_ctrl({"cmd": "stream_begin"})
+        tx.sample_every = self.trace_sample_every
+        self._live_tx = tx
         if self._pending_trace is not None:
             # cascade the dispatcher's trace context down the chain
             # (broadcast on fan-out) ahead of the first relayed tensor
@@ -215,6 +229,22 @@ class StageNode:
         trace_dump: reply with this node's recorded spans as a K_CTRL
                   frame (and drain them) — the dispatcher stitches every
                   stage's spans into one exportable trace.
+        clock_probe: reply with this process's tracer-timeline "now"
+                  ({"cmd": "clock_probe_reply", "t_us", "echo"}) — one
+                  leg of the dispatcher's min-RTT offset estimator
+                  (obs/cluster.py).
+        clock_adjust: {"cmd": "clock_adjust", "offset_us": d} -> shift
+                  the tracer's wall anchor (buffered spans included) so
+                  this process's spans land on the dispatcher's
+                  timeline; ACKed.
+        obs_subscribe: {"cmd": "obs_subscribe", "interval_ms": 250,
+                  "spans": bool, "span_limit": N} -> start pushing
+                  {"cmd": "obs_push"} telemetry frames back on THIS
+                  connection every interval until it closes
+                  (obs/report.py; the live-monitoring plane, no new
+                  ports).  The subscriber must not send further
+                  commands on the connection besides its final END —
+                  pushes and replies would interleave mid-frame.
         """
         from ..utils.export import load_stage_program
 
@@ -255,6 +285,33 @@ class StageNode:
             tr.process = (f"stage{m['index']}" if m is not None
                           else f"node:{self.address[1]}")
             self._pending_trace = {k: v for k, v in msg.items()}
+            # waterfall sampling rides the trace context: every process
+            # of the chain samples the SAME 1-in-N wire sequences
+            self.trace_sample_every = int(msg.get("sample_every", 0) or 0)
+            for ch in (self._live_rx, self._live_tx):
+                if ch is not None:
+                    ch.sample_every = self.trace_sample_every
+            return True
+        if cmd == "clock_probe":
+            send_ctrl(conn, {"cmd": "clock_probe_reply",
+                             "t_us": tracer().now_us(),
+                             "echo": msg.get("echo")})
+            return True
+        if cmd == "clock_adjust":
+            tracer().shift_wall_anchor(int(msg.get("offset_us", 0)))
+            REGISTRY.gauge("clock.offset_us").inc(
+                float(msg.get("offset_us", 0)))
+            send_ack(conn)
+            return True
+        if cmd == "obs_subscribe":
+            rep = ObsReporter(
+                self, conn,
+                interval_s=float(msg.get("interval_ms", 250.0)) / 1e3,
+                spans=bool(msg.get("spans", True)),
+                span_limit=int(msg.get("span_limit", 256)))
+            self._reporters = [r for r in self._reporters
+                               if r.is_alive()] + [rep]
+            rep.start()
             return True
         if cmd == "trace_dump":
             tr = tracer()
@@ -285,17 +342,120 @@ class StageNode:
                 "tx_bytes": reg.counter("transport.tx_bytes").value,
                 "rx_frames": reg.counter("transport.rx_frames").value,
                 "rx_bytes": reg.counter("transport.rx_bytes").value,
+                # per-NODE infer distribution (instance histogram, so
+                # in-process thread chains stay attributable per node)
                 "infer_latency_s":
-                    reg.histogram("node.infer_s").summary(),
+                    (self.infer_hist.summary()
+                     if self.infer_hist is not None
+                     else reg.histogram("node.infer_s").summary()),
+                # phase timing: per-frame recv+decode / encode+send
+                # seconds of the data channels, plus the per-CHANNEL
+                # codec-only costs — the live bottleneck estimate's
+                # inputs (no blocking waits included)
+                "rx_s": reg.histogram("node.rx_s").summary(),
+                "tx_s": reg.histogram("node.tx_s").summary(),
+                "encode_latency_s":
+                    (self._live_tx.enc.summary()
+                     if self._live_tx is not None
+                     else reg.histogram("codec.encode_s").summary()),
+                "decode_latency_s":
+                    (self._live_rx.dec.summary()
+                     if self._live_rx is not None
+                     else reg.histogram("codec.decode_s").summary()),
                 # overlap telemetry: queue occupancy of the async channel
                 # layer and the un-synced device-dispatch window
                 "overlap": self.overlap,
                 "rx_queue_depth": reg.gauge("node.rx_queue_depth").value,
                 "tx_queue_depth": reg.gauge("node.tx_queue_depth").value,
+                "rx_depth": self.rx_depth,
+                "tx_depth": self.tx_depth,
+                # watermark PEEKS (no reset — obs_push owns the
+                # per-interval reset cycle)
+                "rx_watermark": self._chan_hi(self._live_rx),
+                "tx_watermark": self._chan_hi(self._live_tx),
                 "inflight": reg.gauge("node.inflight").value,
             })
             return True
         raise ValueError(f"unknown control command {msg!r}")
+
+    # -- live observability (obs_push payloads) -----------------------------
+
+    @staticmethod
+    def _chan_hi(chan) -> int:
+        """Peek a channel's occupancy watermark without resetting it."""
+        if chan is None:
+            return 0
+        try:
+            return max(int(chan.hi), chan.qsize())
+        except (AttributeError, TypeError):
+            return 0
+
+    def obs_snapshot(self, *, cursor: int = 0, include_spans: bool = True,
+                     span_limit: int = 256) -> tuple[dict, int]:
+        """One ``obs_push`` payload: identity, lifetime counters, queue
+        depths + per-interval watermarks (reset on read), cumulative
+        latency summaries, and — when tracing is live — the spans
+        recorded since ``cursor`` (without draining what ``trace_dump``
+        collects at stream end).  Called by :class:`ObsReporter` on its
+        own thread; everything read here is either an attribute or a
+        GIL-atomic registry instrument, so the hot path never blocks on
+        the reporter.
+
+        Watermarks are reset-on-read and therefore effectively
+        SINGLE-SUBSCRIBER: with several concurrent subscriptions each
+        sees only the peaks since ANY subscriber's last push, so a
+        burst may be split across their reports (cumulative counters
+        and histograms are unaffected)."""
+        m = self.manifest
+        reg = REGISTRY
+        rx, tx = self._live_rx, self._live_tx
+        payload = {
+            "node": {"stage": None if m is None else m["index"],
+                     "name": None if m is None else m["name"],
+                     "replica": self.replica, "fan_in": self.fan_in,
+                     "port": self.address[1], "codec": self.codec},
+            "processed": self.processed,
+            "reweights": self.reweights,
+            "counters": {
+                "tx_frames": reg.counter("transport.tx_frames").value,
+                "tx_bytes": reg.counter("transport.tx_bytes").value,
+                "rx_frames": reg.counter("transport.rx_frames").value,
+                "rx_bytes": reg.counter("transport.rx_bytes").value,
+            },
+            "queues": {
+                "rx_depth": self.rx_depth, "tx_depth": self.tx_depth,
+                "rx": rx.qsize() if rx is not None else 0,
+                "tx": tx.qsize() if tx is not None else 0,
+                "rx_hi": rx.take_watermark() if rx is not None else 0,
+                "tx_hi": tx.take_watermark() if tx is not None else 0,
+                "inflight": reg.gauge("node.inflight").value,
+                "merge": self._merge.qsize()
+                if self._merge is not None else 0,
+            },
+            "latency": {
+                # per-node / per-channel instruments where they exist
+                # (correct attribution even when in-process nodes share
+                # the registry); process-wide registry as the fallback
+                "infer_s": (self.infer_hist.summary()
+                            if self.infer_hist is not None
+                            else reg.histogram("node.infer_s").summary()),
+                "rx_s": reg.histogram("node.rx_s").summary(),
+                "tx_s": reg.histogram("node.tx_s").summary(),
+                "encode_s": (tx.enc.summary() if tx is not None
+                             else reg.histogram(
+                                 "codec.encode_s").summary()),
+                "decode_s": (rx.dec.summary() if rx is not None
+                             else reg.histogram(
+                                 "codec.decode_s").summary()),
+            },
+        }
+        tr = tracer()
+        trace_doc: dict = {"dropped": tr.dropped}
+        if include_spans and tr.enabled:
+            cursor, spans = tr.spans_since(cursor, limit=span_limit)
+            trace_doc["spans"] = spans
+        payload["trace"] = trace_doc
+        return payload, cursor
 
     def serve(self, *, connect_timeout_s: float = 30.0) -> int:
         """Serve control/data connections until a data stream completes.
@@ -407,15 +567,18 @@ class StageNode:
         def drain_one():
             nonlocal n, streamed
             t0, s, y, relay_seq = pending.popleft()
-            inflight_g.v = len(pending)
+            inflight_g.dec()
             y = np.asarray(y)  # host sync of the OLDEST in-flight output
             dt = time.perf_counter() - t0
             infer_hist.record(dt)
+            if self.infer_hist is not None:
+                self.infer_hist.record(dt)
             tr = tracer()
-            if tr.enabled:
+            if tr.enabled and _sampled(self.trace_sample_every, relay_seq):
                 tr.record(
                     f"{self._span_label()}.infer", t0, dt,
-                    {"seq": s, "stage": self.manifest["index"]})
+                    {"seq": s if relay_seq is None else relay_seq,
+                     "stage": self.manifest["index"]})
             self.processed += 1  # before the send: a stats query can
             #   race the relay of the final tensor otherwise
             tx.send(y, seq=relay_seq)
@@ -487,6 +650,9 @@ class StageNode:
                 if tx is None:
                     tx, out_socks = self._make_tx(connect_timeout_s)
                     rx.bind_gauge("node.rx_queue_depth")
+                    rx.bind_hist("node.rx_s")
+                    rx.sample_every = self.trace_sample_every
+                    self._live_rx = rx
                 want = tuple(self.manifest["in_shape"])
                 if tuple(value.shape[1:]) != want:
                     raise ValueError(
@@ -495,7 +661,7 @@ class StageNode:
                 t0 = time.perf_counter()
                 pending.append((t0, seq, self.prog(value), relay_seq))
                 seq += 1
-                inflight_g.v = len(pending)
+                inflight_g.inc()
                 while len(pending) >= self.inflight:
                     drain_one()
         except Exception as e:  # noqa: BLE001 — see below
@@ -509,6 +675,14 @@ class StageNode:
                   file=sys.stderr, flush=True)
             return None
         finally:
+            # reconcile the ADDITIVE gauges: an abandoned stream's
+            # queued frames / un-synced dispatches are never consumed,
+            # and must not inflate the shared readings forever
+            if self._live_rx is rx:
+                self._live_rx = None
+            rx.release_gauge()
+            if pending:
+                inflight_g.dec(len(pending))
             if out_socks is not None:
                 for s in out_socks:
                     s.close()
@@ -582,11 +756,15 @@ class StageNode:
                 y = np.asarray(self.prog(value))
                 dt = time.perf_counter() - t0
                 infer_hist.record(dt)
+                if self.infer_hist is not None:
+                    self.infer_hist.record(dt)
                 tr = tracer()
-                if tr.enabled:
+                if tr.enabled and _sampled(self.trace_sample_every,
+                                           relay_seq):
                     tr.record(
                         f"{self._span_label()}.infer", t0, dt,
-                        {"seq": n, "stage": self.manifest["index"]})
+                        {"seq": n if relay_seq is None else relay_seq,
+                         "stage": self.manifest["index"]})
                 self.processed += 1  # before the send: a stats query can
                 #   race the relay of the final tensor otherwise
                 send_frame(out, y, codec=self.codec, seq=relay_seq)
@@ -714,10 +892,12 @@ class StageNode:
         def drain_one():
             nonlocal n
             t0, s, y = pending.popleft()
-            inflight_g.v = len(pending)
+            inflight_g.dec()
             y = np.asarray(y)
             dt = time.perf_counter() - t0
             infer_hist.record(dt)
+            if self.infer_hist is not None:
+                self.infer_hist.record(dt)
             tr = tracer()
             if tr.enabled:
                 tr.record(f"{self._span_label()}.infer", t0, dt,
@@ -771,10 +951,14 @@ class StageNode:
                 t0 = time.perf_counter()
                 pending.append((t0, seq, self.prog(value)))
                 seq += 1
-                inflight_g.v = len(pending)
+                inflight_g.inc()
                 while len(pending) >= self.inflight:
                     drain_one()
         finally:
+            if pending:
+                # reconcile: dispatches abandoned by a failed stream
+                # must not inflate the shared inflight gauge forever
+                inflight_g.dec(len(pending))
             if out_socks is not None:
                 for s in out_socks:
                     s.close()
@@ -796,6 +980,15 @@ class ChainDispatcher:
     tx_depth: int = 8
     rx_depth: int = 8
     result_fan_in: int = 1
+    #: waterfall sampling period (docs/OBSERVABILITY.md): with tracing
+    #: enabled and N >= 1, every tensor frame is stamped with its stream
+    #: sequence number and only 1-in-N frames record per-frame spans —
+    #: in EVERY process of the chain, keyed on the wire seq, so the
+    #: sampled frame's rx-wait/infer/tx-wait path stitches end to end
+    trace_sample_every: int = 0
+    #: class default covers ``__new__``-built instances (tests): the
+    #: first ``+=`` then creates the instance attribute
+    _stream_seq: int = 0
     _tx_chan = None              # AsyncSender | FanOutSender | None
     _rx_chan: AsyncReceiver | None = None
     _send_socks: list | None = None
@@ -805,7 +998,8 @@ class ChainDispatcher:
                  codec: str = "raw", window: int = 64,
                  timeout_s: float | None = None,
                  tx_depth: int = 8, rx_depth: int = 8,
-                 result_fan_in: int = 1):
+                 result_fan_in: int = 1,
+                 trace_sample_every: int = 0):
         if timeout_s is not None:
             self.timeout_s = timeout_s
         host, port = _parse_hostport(listen)
@@ -823,6 +1017,11 @@ class ChainDispatcher:
         #: >1 = replicated LAST stage: R replicas dial the result server
         #: back and the dispatcher merges them in sequence order
         self.result_fan_in = max(1, result_fan_in)
+        self.trace_sample_every = max(0, int(trace_sample_every))
+        #: wire sequence counter, continuous across stream() calls (a
+        #: warm stream and a timed stream must not reuse seq numbers —
+        #: sampled spans are keyed by them)
+        self._stream_seq = 0
         self._send_sock: socket.socket | None = None
         self._send_socks = None
         self._res_conn: socket.socket | None = None
@@ -849,14 +1048,17 @@ class ChainDispatcher:
                                              depth=self.tx_depth,
                                              codec=self.codec,
                                              gauge="chain.tx_queue_depth",
-                                             span="chain")
+                                             span="chain",
+                                             hist="chain.tx_s")
                 self._tx_chan.send_ctrl({"cmd": "stream_begin"})
             else:
                 self._tx_chan = AsyncSender(self._send_sock,
                                             depth=self.tx_depth,
                                             codec=self.codec,
                                             gauge="chain.tx_queue_depth",
-                                            span="chain")
+                                            span="chain",
+                                            hist="chain.tx_s")
+            self._tx_chan.sample_every = self.trace_sample_every
         # the result connection is accepted lazily in _recv_tensor: the
         # last node only dials back once its first tensor arrives, so
         # accepting before sending anything would deadlock the chain
@@ -890,7 +1092,12 @@ class ChainDispatcher:
             root_span = new_span_id()
             self._tx_chan.send_ctrl(
                 {"cmd": "trace", "trace_id": tr.trace_id,
-                 "span_id": root_span})
+                 "span_id": root_span,
+                 "sample_every": self.trace_sample_every})
+        # waterfall sampling needs a wire sequence number on every frame
+        # (a FanOutSender stamps its own — don't double-stamp)
+        stamp_seq = (tr.enabled and self.trace_sample_every > 0
+                     and not isinstance(self._tx_chan, FanOutSender))
         outs: list[np.ndarray] = []
         window = threading.Semaphore(self.window)
         sent = [0]
@@ -910,11 +1117,15 @@ class ChainDispatcher:
                             f"flight — a stage is stuck")
                     if rx_failed.is_set():
                         return  # woken by the error path, not a result
-                    self._tx_chan.send(np.asarray(x))
+                    self._tx_chan.send(
+                        np.asarray(x),
+                        seq=(self._stream_seq + sent[0]) if stamp_seq
+                        else None)
                     sent[0] += 1
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 err.append(e)
             finally:
+                self._stream_seq += sent[0]
                 tx_done.set()
 
         t = threading.Thread(target=tx, daemon=True, name="chain-tx")
@@ -1071,13 +1282,19 @@ class ChainDispatcher:
             self._rx_chan = AsyncReceiver(self._res_conn,
                                           depth=self.rx_depth,
                                           gauge="chain.rx_queue_depth",
-                                          span="chain")
+                                          span="chain",
+                                          hist="chain.rx_s")
+            self._rx_chan.sample_every = self.trace_sample_every
         kind, y = self._rx_chan.get(timeout=self.timeout_s)
         while kind == K_CTRL and isinstance(y, dict) \
                 and y.get("cmd") in ("trace", "stream_begin"):
             # the last node cascaded the trace context / stream marker to
             # the result hop; informational — the dispatcher originated it
             kind, y = self._rx_chan.get(timeout=self.timeout_s)
+        if kind == K_TENSOR_SEQ:
+            # waterfall sampling stamps every frame end to end; the
+            # result hop carries the stamp through — strip it here
+            return y[1]
         if kind != K_TENSOR:
             raise ConnectionError(
                 f"chain returned frame kind {kind!r} while results were "
@@ -1142,6 +1359,41 @@ class ChainDispatcher:
                 f"still in flight (a stage replica died and cascaded "
                 f"END?)")
         return y
+
+    def align_clocks(self, node_addrs: Sequence[str], *,
+                     rounds: int = 8) -> dict:
+        """Clock-align every node's tracer to this process's timeline:
+        per node, a min-RTT ping-pong offset estimate over a control
+        connection followed by a ``clock_adjust`` shifting the node's
+        ``Tracer._wall0_us`` anchor (obs/cluster.py).  Call before
+        ``stream`` when exporting cross-process traces, so every
+        process's spans land on one coherent Perfetto axis.  Returns
+        ``{addr: {"offset_us", "rtt_us", ...}}``."""
+        from ..obs.cluster import align_clock
+        out = {}
+        for addr in node_addrs:
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=self.timeout_s)
+            try:
+                out[addr] = align_clock(s, rounds=rounds)
+                send_end(s)
+            finally:
+                s.close()
+        return out
+
+    def watch(self, node_addrs: Sequence[str], *,
+              interval_ms: float = 250.0, spans: bool = False,
+              align_clocks: bool = False):
+        """Subscribe to every node's live obs_push stream: returns a
+        :class:`~defer_tpu.obs.cluster.ClusterView` aggregating pushes
+        on background reader threads until ``view.close()``.  Works
+        mid-stream (thread-per-connection nodes) — this is the push
+        plane the ``defer_tpu monitor`` CLI renders."""
+        from ..obs.cluster import ClusterView
+        view = ClusterView()
+        view.connect(node_addrs, interval_ms=interval_ms, spans=spans,
+                     align_clocks=align_clocks, timeout_s=self.timeout_s)
+        return view
 
     def collect_trace(self, node_addrs: Sequence[str]) -> int:
         """Fetch and merge every node's recorded spans into this process's
@@ -1216,6 +1468,10 @@ class ChainDispatcher:
         except (OSError, ConnectionError, ValueError, TimeoutError):
             pass  # teardown after failure: keep the root cause
         finally:
+            if self._rx_chan is not None:
+                # reconcile the additive chain.rx_queue_depth gauge: a
+                # teardown after failure can abandon queued results
+                self._rx_chan.release_gauge()
             if self._send_sock is not None:
                 self._send_sock.close()
             for s in self._send_socks or []:
@@ -1309,7 +1565,10 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               hop_codecs: Sequence[str] | None = None,
               stats_out: list | None = None,
               spawn_retries: int = 3,
-              on_spawn=None) -> list[np.ndarray]:
+              on_spawn=None,
+              trace_sample_every: int = 0,
+              plan=None, graph=None,
+              report_interval_ms: float = 250.0) -> list[np.ndarray]:
     """Export, spawn one OS process per stage REPLICA, stream, tear down.
 
     The one-call analogue of the reference's whole deployment procedure
@@ -1340,6 +1599,19 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     propagates — a mid-deploy crash cannot leak live replica processes.
     ``on_spawn(procs)`` is a test/instrumentation hook called with the
     freshly spawned ``subprocess.Popen`` list of each attempt.
+
+    Live observability (docs/OBSERVABILITY.md): with tracing enabled the
+    dispatcher clock-aligns every node before streaming (min-RTT offset
+    estimate + ``clock_adjust``), and ``trace_sample_every=N`` switches
+    per-frame spans to 1-in-N waterfall sampling keyed on the wire
+    sequence number.  ``plan`` (the deployment's solved
+    :class:`~defer_tpu.plan.solver.Plan`) together with ``stats_out``
+    subscribes a live :class:`~defer_tpu.obs.cluster.ClusterView` to
+    every node's obs_push stream (``report_interval_ms`` cadence) and
+    appends one extra ``{"obs": ...}`` row to ``stats_out`` carrying the
+    live rows, the detected bottleneck stage, and any straggler flags;
+    pass ``graph`` too and the row gains a ``replan`` suggestion from
+    :func:`defer_tpu.plan.replan.replan` fed with the live measurements.
 
     ``env`` overrides the child environment.  By default children are
     pinned to the CPU backend: a local chain is a topology demonstration,
@@ -1393,7 +1665,10 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                     in_band=in_band, tuning=tuning, child_env=child_env,
                     artifact_dir=artifact_dir, rx_depth=rx_depth,
                     tx_depth=tx_depth, stats_out=stats_out,
-                    on_spawn=on_spawn)
+                    on_spawn=on_spawn,
+                    trace_sample_every=trace_sample_every,
+                    plan=plan, graph=graph,
+                    report_interval_ms=report_interval_ms)
             except _BindRace as e:
                 last_exc = e
                 print(f"run_chain: bind race on attempt {attempt + 1} "
@@ -1445,7 +1720,9 @@ def _await_binds(procs, labels, logs, flat_addrs, *,
 
 def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                    r_of, paths, in_band, tuning, child_env, artifact_dir,
-                   rx_depth, tx_depth, stats_out, on_spawn):
+                   rx_depth, tx_depth, stats_out, on_spawn,
+                   trace_sample_every=0, plan=None, graph=None,
+                   report_interval_ms=250.0):
     """One spawn -> deploy -> stream -> teardown attempt (see
     ``run_chain``).  Raises :class:`_BindRace` when a child died with an
     address-in-use failure; any other failure surfaces the dead node's
@@ -1505,7 +1782,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                                    # dispatcher's own feed/drain channels
                                    tx_depth=tx_depth if tx_depth else 8,
                                    rx_depth=rx_depth if rx_depth else 8,
-                                   result_fan_in=r_of[-1])
+                                   result_fan_in=r_of[-1],
+                                   trace_sample_every=trace_sample_every)
         except OSError as e:
             import errno
             if getattr(e, "errno", None) == errno.EADDRINUSE \
@@ -1517,15 +1795,44 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                     f"({e})") from e
             raise
         flat_addrs = flat
+        view = None
         try:
             if in_band:
                 disp.deploy(stages, params, addrs, batch=batch,
                             codecs=codec_of)
+            if tracer().enabled:
+                # one coherent cross-process timeline: correct every
+                # node's wall anchor before any stream spans record
+                try:
+                    disp.align_clocks(flat_addrs)
+                except (OSError, ConnectionError) as e:
+                    print(f"run_chain: clock alignment failed: {e!r}",
+                          file=sys.stderr)
+            if plan is not None and stats_out is not None:
+                # live observation loop: subscribe to every node's
+                # obs_push stream for the duration of the stream
+                view = disp.watch(flat_addrs,
+                                  interval_ms=report_interval_ms)
             outs = disp.stream(inputs)
             if stats_out is not None:
                 # per-replica observability, queried while the nodes are
                 # still serving (they exit once close() cascades END)
                 stats_out.extend(disp.stats(flat_addrs))
+            if view is not None:
+                from ..obs.cluster import (StragglerDetector,
+                                           expected_stage_ms)
+                det = StragglerDetector(expected_stage_ms(plan))
+                obs = {"rows": view.rows(),
+                       "bottleneck": view.bottleneck(),
+                       "stragglers": [f.to_json()
+                                      for f in det.observe(view)]}
+                if graph is not None:
+                    try:
+                        obs["replan"] = det.suggest(
+                            view, graph, plan).to_json()
+                    except Exception as e:  # noqa: BLE001 — advisory
+                        obs["replan_error"] = repr(e)
+                stats_out.append({"obs": obs})
             if tracer().enabled:
                 # stitch every stage process's spans into this process's
                 # tracer while the nodes are still serving
@@ -1538,6 +1845,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             failure = e
             raise
         finally:
+            if view is not None:
+                view.close()
             if failure is not None:
                 # hardened teardown: kill the children FIRST so the
                 # dispatcher's drain hits dead sockets (fast) instead of

@@ -23,9 +23,16 @@ chained to the tx thread's failure (and the dead thread drains the queue
 so a producer parked in ``send`` always wakes).
 
 Telemetry: pass ``gauge="node.rx_queue_depth"`` to publish the queue's
-occupancy as a registry gauge, and ``span=<name or callable>`` to record a
-``<name>.rx`` / ``<name>.tx`` span per frame when the process tracer is
-enabled — the Perfetto view of rx/compute/tx actually overlapping.
+occupancy as a registry gauge (ADDITIVE ``inc``/``dec`` updates, so
+several channels sharing a name report their total; ``take_watermark``
+returns the per-interval peak), ``hist="node.rx_s"`` to record per-frame
+recv+decode / encode+send seconds, and ``span=<name or callable>`` to
+record a ``<name>.rx`` / ``<name>.tx`` span per frame when the process
+tracer is enabled — the Perfetto view of rx/compute/tx actually
+overlapping.  Setting ``sample_every = N`` switches per-frame spans to
+1-in-N waterfall sampling keyed on the wire sequence number, adding
+``.rx_wait`` / ``.tx_wait`` queue-time spans for the sampled frames
+(docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -35,8 +42,9 @@ import threading
 import time
 from typing import Callable
 
-from ..obs import REGISTRY, tracer
-from .framed import K_END, recv_frame, send_ctrl, send_end, send_frame
+from ..obs import REGISTRY, LatencyHistogram, tracer
+from .framed import (K_END, K_TENSOR, K_TENSOR_SEQ, recv_frame, send_ctrl,
+                     send_end, send_frame)
 
 #: rx-queue sentinel: the thread died, ``err`` holds why
 _ERR = object()
@@ -54,6 +62,19 @@ def _resolve_label(span) -> Callable[[], str] | None:
     return span if callable(span) else (lambda: span)
 
 
+def _sampled(sample_every: int, seq: int | None) -> bool:
+    """Waterfall sampling predicate: ``sample_every <= 0`` keeps the
+    pre-sampling behavior (every frame records its span); ``N >= 1``
+    records only frames whose WIRE sequence number is a multiple of N —
+    the same 1-in-N frames in every process of the chain, so the sampled
+    frame's full rx-wait/infer/tx-wait path stitches into one waterfall
+    (docs/OBSERVABILITY.md).  Frames without a wire seq are not sampled.
+    """
+    if sample_every <= 0:
+        return True
+    return seq is not None and seq % sample_every == 0
+
+
 class AsyncReceiver:
     """Daemon rx thread: recv + decode into a bounded in-order queue.
 
@@ -62,14 +83,28 @@ class AsyncReceiver:
     the rx thread's failure once the queue is drained.
     """
 
+    #: waterfall sampling period for per-frame spans (0 = every frame);
+    #: set by the owner when the trace context carries ``sample_every``
+    sample_every: int = 0
+
     def __init__(self, sock, *, depth: int = 8, gauge: str | None = None,
-                 span=None):
+                 span=None, hist: str | None = None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._sock = sock
+        self.depth = depth
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._gauge = REGISTRY.gauge(gauge) if gauge else None
         self._span = _resolve_label(span)
+        #: registry histogram of recv+decode seconds per tensor frame
+        #: (always-on; the live bottleneck estimate reads it)
+        self._hist = REGISTRY.histogram(hist) if hist else None
+        #: per-CHANNEL decode seconds (codec work only, no blocking recv
+        #: wait) — the live bottleneck estimate's per-node attribution
+        #: even when several in-process nodes share the registry
+        self.dec = LatencyHistogram()
+        #: high watermark of queue occupancy since take_watermark()
+        self.hi = 0
         self.err: BaseException | None = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="channel-rx")
@@ -79,23 +114,62 @@ class AsyncReceiver:
         """Start publishing queue occupancy under ``name`` — for callers
         that only later learn this connection is worth monitoring (a node
         binds its gauge once a connection becomes THE data stream, so
-        short-lived control connections never clobber the reading)."""
-        self._gauge = REGISTRY.gauge(name)
+        short-lived control connections never clobber the reading).
+        Gauge updates are ADDITIVE (``inc``/``dec``) so several channels
+        sharing one name report their total; binding syncs the current
+        occupancy in (±1 transient if the rx thread races the bind)."""
+        g = REGISTRY.gauge(name)
+        g.inc(self._q.qsize())
+        self._gauge = g
+
+    def bind_hist(self, name: str) -> None:
+        """Start recording per-frame recv+decode seconds under ``name``
+        (bound with the gauge once a connection proves to be the data
+        stream)."""
+        self._hist = REGISTRY.histogram(name)
+
+    def take_watermark(self) -> int:
+        """Max queue occupancy since the previous call (the per-interval
+        depth watermark an obs_push reports)."""
+        h = max(self.hi, self._q.qsize())
+        self.hi = self._q.qsize()
+        return h
+
+    def release_gauge(self) -> None:
+        """Return this channel's remaining contribution to its shared
+        ADDITIVE gauge and unbind: a stream abandoned mid-flight leaves
+        queued frames nobody will ever dequeue, and without this the
+        gauge would carry the dead stream's depth forever (the old
+        absolute-set updates self-corrected; additive ones must
+        reconcile).  ±1 transient if the rx thread races the unbind."""
+        g, self._gauge = self._gauge, None
+        if g is not None:
+            g.dec(self._q.qsize())
 
     def _run(self):
         n = 0
         try:
             while True:
                 t0 = time.perf_counter()
-                kind, value = recv_frame(self._sock)
-                tr = tracer()
-                if tr.enabled and self._span is not None:
-                    tr.record(f"{self._span()}.rx", t0,
-                              time.perf_counter() - t0, {"seq": n})
+                kind, value = recv_frame(self._sock,
+                                         on_decode=self.dec.record)
+                dt = time.perf_counter() - t0
+                if kind in (K_TENSOR, K_TENSOR_SEQ):
+                    if self._hist is not None:
+                        self._hist.record(dt)
+                    tr = tracer()
+                    if tr.enabled and self._span is not None:
+                        seq = value[0] if kind == K_TENSOR_SEQ else None
+                        if _sampled(self.sample_every, seq):
+                            tr.record(f"{self._span()}.rx", t0, dt,
+                                      {"seq": n if seq is None else seq})
                 n += 1
-                self._q.put((kind, value))
+                self._q.put((kind, value, time.perf_counter()))
                 if self._gauge is not None:
-                    self._gauge.v = self._q.qsize()
+                    self._gauge.inc()
+                q = self._q.qsize()
+                if q > self.hi:
+                    self.hi = q
                 if kind == K_END:
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in get()
@@ -134,11 +208,21 @@ class AsyncReceiver:
         return self._unwrap(item)
 
     def _unwrap(self, item) -> tuple:
-        if self._gauge is not None:
-            self._gauge.v = self._q.qsize()
         if item is _ERR:
             raise self.err
-        return item
+        if self._gauge is not None:
+            self._gauge.dec()
+        kind, value, t_enq = item
+        if self._span is not None and self.sample_every > 0:
+            # waterfall sampling: how long the sampled frame waited in
+            # the rx queue before the compute loop took it
+            tr = tracer()
+            seq = value[0] if kind == K_TENSOR_SEQ else None
+            if tr.enabled and _sampled(self.sample_every, seq):
+                now = time.perf_counter()
+                tr.record(f"{self._span()}.rx_wait", t_enq, now - t_enq,
+                          {"seq": seq})
+        return kind, value
 
     def qsize(self) -> int:
         return self._q.qsize()
@@ -153,19 +237,37 @@ class AsyncSender:
     the queue is drained so a parked producer always wakes.
     """
 
+    #: waterfall sampling period for per-frame spans (0 = every frame)
+    sample_every: int = 0
+
     def __init__(self, sock, *, depth: int = 8, codec: str = "raw",
-                 gauge: str | None = None, span=None):
+                 gauge: str | None = None, span=None,
+                 hist: str | None = None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._sock = sock
         self.codec = codec
+        self.depth = depth
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._gauge = REGISTRY.gauge(gauge) if gauge else None
         self._span = _resolve_label(span)
+        #: registry histogram of encode+send seconds per tensor frame
+        self._hist = REGISTRY.histogram(hist) if hist else None
+        #: per-CHANNEL encode seconds (codec work only) — see
+        #: ``AsyncReceiver.dec``
+        self.enc = LatencyHistogram()
+        #: high watermark of queue occupancy since take_watermark()
+        self.hi = 0
         self.err: BaseException | None = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="channel-tx")
         self._thread.start()
+
+    def take_watermark(self) -> int:
+        """Max queue occupancy since the previous call."""
+        h = max(self.hi, self._q.qsize())
+        self.hi = self._q.qsize()
+        return h
 
     # -- producer side -----------------------------------------------------
 
@@ -216,11 +318,14 @@ class AsyncSender:
             if self.err is not None:
                 raise ChannelError("transport tx thread died") from self.err
             try:
-                self._q.put(item, timeout=0.05)
+                self._q.put(item + (time.perf_counter(),), timeout=0.05)
             except queue.Full:
                 continue
             if self._gauge is not None:
-                self._gauge.v = self._q.qsize()
+                self._gauge.inc()
+            q = self._q.qsize()
+            if q > self.hi:
+                self.hi = q
             return
 
     def qsize(self) -> int:
@@ -232,37 +337,50 @@ class AsyncSender:
         n = 0
         try:
             while True:
-                kind, v = self._q.get()
+                kind, v, t_enq = self._q.get()
                 if self._gauge is not None:
-                    self._gauge.v = self._q.qsize()
+                    self._gauge.dec()
                 if kind == _FLUSH:
                     v.set()
                     continue
                 t0 = time.perf_counter()
                 if kind == _TENSOR:
-                    send_frame(self._sock, v, codec=self.codec)
+                    send_frame(self._sock, v, codec=self.codec,
+                               on_encode=self.enc.record)
                 elif kind == _TENSOR_SEQ:
                     send_frame(self._sock, v[1], codec=self.codec,
-                               seq=v[0])
+                               seq=v[0], on_encode=self.enc.record)
                 elif kind == _CTRL:
                     send_ctrl(self._sock, v)
                 else:
                     send_end(self._sock)
-                tr = tracer()
-                if tr.enabled and self._span is not None \
-                        and kind in (_TENSOR, _TENSOR_SEQ):
-                    tr.record(f"{self._span()}.tx", t0,
-                              time.perf_counter() - t0,
-                              {"seq": v[0] if kind == _TENSOR_SEQ else n})
+                if kind in (_TENSOR, _TENSOR_SEQ):
+                    dt = time.perf_counter() - t0
+                    if self._hist is not None:
+                        self._hist.record(dt)
+                    tr = tracer()
+                    if tr.enabled and self._span is not None:
+                        seq = v[0] if kind == _TENSOR_SEQ else None
+                        if _sampled(self.sample_every, seq):
+                            label = self._span()
+                            if self.sample_every > 0:
+                                # waterfall sampling: queue wait before
+                                # the frame reached the wire
+                                tr.record(f"{label}.tx_wait", t_enq,
+                                          t0 - t_enq, {"seq": seq})
+                            tr.record(f"{label}.tx", t0, dt,
+                                      {"seq": n if seq is None else seq})
                 n += 1
                 if kind == _END:
                     # release any flush marker enqueued after the END so
                     # a racing flush() can never hang on a dead thread
                     while True:
                         try:
-                            k2, v2 = self._q.get_nowait()
+                            k2, v2, _ = self._q.get_nowait()
                         except queue.Empty:
                             return
+                        if self._gauge is not None:
+                            self._gauge.dec()
                         if k2 == _FLUSH:
                             v2.set()
         except BaseException as e:  # noqa: BLE001 — surfaced in _put/flush
@@ -271,8 +389,10 @@ class AsyncSender:
             # items still queued are dropped (the wire is dead anyway)
             while True:
                 try:
-                    kind, v = self._q.get_nowait()
+                    kind, v, _ = self._q.get_nowait()
                 except queue.Empty:
                     return
+                if self._gauge is not None:
+                    self._gauge.dec()
                 if kind == _FLUSH:
                     v.set()  # flush re-checks err after the event fires

@@ -90,6 +90,26 @@ def configure_socket(sock: socket.socket, *, nodelay: bool = True,
         pass
     return sock
 
+
+def connect_retry(host: str, port: int, timeout_s: float = 30.0
+                  ) -> socket.socket:
+    """Connect to a peer that may still be booting: exponential-backoff
+    retry (50 ms doubling to 1 s) until ``timeout_s``, returning a
+    :func:`configure_socket`-tuned connection.  The one retry policy for
+    every control/data dial in the chain (stage nodes, dispatcher,
+    monitor subscriptions)."""
+    deadline = time.monotonic() + timeout_s
+    delay = 0.05
+    while True:
+        try:
+            return configure_socket(
+                socket.create_connection((host, port), timeout=timeout_s))
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
 #: frame kinds
 K_TENSOR = 1
 K_BYTES = 2
@@ -214,12 +234,15 @@ def _sendv(sock: socket.socket, *parts) -> None:
 
 
 def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw",
-               seq: int | None = None):
+               seq: int | None = None, on_encode=None):
     """Send one typed frame (tensor or raw bytes).
 
     ``seq`` (tensor frames only) stamps the frame with a u64 stream
     sequence number (kind ``K_TENSOR_SEQ``, protocol v2) so a fan-in
-    downstream of data-parallel replicas can restore stream order."""
+    downstream of data-parallel replicas can restore stream order.
+    ``on_encode(dt_s)`` is called with the encode seconds of a tensor
+    frame — per-CHANNEL cost attribution (the process-wide
+    ``codec.encode_s`` histogram records regardless)."""
     if isinstance(arr_or_bytes, (bytes, bytearray, memoryview)):
         kind, payload = K_BYTES, arr_or_bytes  # scatter-gather: no copy
         meta = b""
@@ -238,7 +261,10 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw",
                 payload = _codec(codec).encode(arr)
         else:
             payload = _codec(codec).encode(arr)
-        _ENC_HIST.record(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _ENC_HIST.record(dt)
+        if on_encode is not None:
+            on_encode(dt)
         cname = codec.encode()
         dt = arr.dtype.str.encode()
         meta = dt + b"".join(struct.pack(">Q", s) for s in arr.shape)
@@ -298,10 +324,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(_recv_into(sock, n))
 
 
-def recv_frame(sock: socket.socket) -> tuple[int, Any]:
+def recv_frame(sock: socket.socket, *, on_decode=None) -> tuple[int, Any]:
     """Receive one frame -> (kind, payload).  Tensor frames are decoded to
     ndarrays; K_END returns (K_END, None); K_TENSOR_SEQ (protocol v2)
-    returns (K_TENSOR_SEQ, (seq, ndarray))."""
+    returns (K_TENSOR_SEQ, (seq, ndarray)).  ``on_decode(dt_s)`` is
+    called with the decode seconds of a tensor frame — per-CHANNEL cost
+    attribution, excluding the blocking recv wait (the process-wide
+    ``codec.decode_s`` histogram records regardless)."""
     kind, clen, dlen, ndim, plen = _HDR.unpack(_recv_into(sock, _HDR.size))
     _RX_FRAMES.n += 1
     _RX_BYTES.n += _HDR.size + clen + dlen + 8 * ndim + plen
@@ -332,7 +361,10 @@ def recv_frame(sock: socket.socket) -> tuple[int, Any]:
         value = np.frombuffer(buf, dtype=dt).reshape(shape)
     else:
         value = _codec(cname).decode(memoryview(buf), shape, dt)
-    _DEC_HIST.record(time.perf_counter() - t0)
+    dt_dec = time.perf_counter() - t0
+    _DEC_HIST.record(dt_dec)
+    if on_decode is not None:
+        on_decode(dt_dec)
     if seq is not None:
         return K_TENSOR_SEQ, (seq, value)
     return K_TENSOR, value

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Sequence
 
+from ..obs import LatencyHistogram
 from .channel import AsyncSender
 from .framed import K_CTRL, K_END, K_TENSOR
 
@@ -189,16 +190,47 @@ class FanOutSender:
     """
 
     def __init__(self, socks: Sequence, *, depth: int = 8,
-                 codec: str = "raw", gauge: str | None = None, span=None):
+                 codec: str = "raw", gauge: str | None = None, span=None,
+                 hist: str | None = None):
         if not socks:
             raise ValueError("FanOutSender needs at least one socket")
         self._chans = [AsyncSender(s, depth=depth, codec=codec,
-                                   gauge=gauge, span=span) for s in socks]
+                                   gauge=gauge, span=span, hist=hist)
+                       for s in socks]
         self._n = 0
+        self.depth = depth
 
     @property
     def width(self) -> int:
         return len(self._chans)
+
+    @property
+    def sample_every(self) -> int:
+        return self._chans[0].sample_every
+
+    @sample_every.setter
+    def sample_every(self, n: int) -> None:
+        for ch in self._chans:
+            ch.sample_every = n
+
+    def take_watermark(self) -> int:
+        """Peak occupancy across the replica channels since last call."""
+        return max(ch.take_watermark() for ch in self._chans)
+
+    @property
+    def hi(self) -> int:
+        """Non-resetting watermark PEEK across the replica channels —
+        what a ``stats`` reply reads (``StageNode._chan_hi``) without
+        disturbing the obs_push reset cycle."""
+        return max(ch.hi for ch in self._chans)
+
+    @property
+    def enc(self) -> LatencyHistogram:
+        """Merged per-channel encode histogram (``AsyncSender.enc``)."""
+        h = LatencyHistogram()
+        for ch in self._chans:
+            h.merge(ch.enc)
+        return h
 
     def send(self, arr, *, seq: int | None = None) -> None:
         self._chans[self._n % len(self._chans)].send(arr, seq=self._n)

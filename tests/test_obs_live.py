@@ -1,0 +1,572 @@
+"""Live observability plane: tracer span cap + cursors, clock
+alignment, Gauge inc/dec, Prometheus exposition hardening + HTTP
+endpoint, ClusterView aggregation, straggler detection and its replan
+suggestion, and the obs_push path through a real (in-process) 3-node
+chain."""
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu.obs import (REGISTRY, ClusterView, Gauge, MetricsRegistry,
+                           StragglerDetector, Tracer, start_prom_server,
+                           tracer)
+from defer_tpu.obs.cluster import (align_clock, estimate_clock_offset,
+                                   expected_stage_ms)
+
+
+# ---------------------------------------------------------------------------
+# tracer: span-buffer cap + incremental cursors + anchor shift
+# ---------------------------------------------------------------------------
+
+def test_tracer_span_cap_and_dropped_counter():
+    """A long traced stream must not grow memory without bound: past
+    max_spans the OLDEST spans are evicted and counted (satellite
+    regression test)."""
+    dropped0 = REGISTRY.counter("trace.dropped_spans").value
+    t = Tracer(process="t", enabled=True, max_spans=10)
+    for i in range(25):
+        t.record(f"s{i}", 0.0, 0.001)
+    assert len(t.spans) == 10
+    assert t.dropped == 15
+    # the NEWEST spans survive (a live monitor wants recent history)
+    assert [s["name"] for s in t.spans] == [f"s{i}" for i in range(15, 25)]
+    assert REGISTRY.counter("trace.dropped_spans").value == dropped0 + 15
+    # ingest respects the cap too
+    t.ingest([{"name": f"x{i}", "ts_us": 0, "dur_us": 1} for i in range(8)])
+    assert len(t.spans) == 10 and t.dropped == 15 + 8
+
+
+def test_tracer_span_cursor_batches():
+    """spans_since reads incrementally WITHOUT draining — pushes and the
+    end-of-stream trace_dump must not steal from each other."""
+    t = Tracer(process="t", enabled=True)
+    c0 = t.span_cursor()
+    t.record("a", 0.0, 0.001)
+    t.record("b", 0.0, 0.001)
+    c1, batch = t.spans_since(c0)
+    assert [s["name"] for s in batch] == ["a", "b"]
+    c2, batch = t.spans_since(c1)
+    assert batch == [] and c2 == c1
+    t.record("c", 0.0, 0.001)
+    _, batch = t.spans_since(c1)
+    assert [s["name"] for s in batch] == ["c"]
+    # limit keeps the newest of an oversized batch
+    for i in range(5):
+        t.record(f"d{i}", 0.0, 0.001)
+    _, batch = t.spans_since(c2, limit=2)
+    assert [s["name"] for s in batch] == ["d3", "d4"]
+    # a drain moves the base; an old cursor stays valid (returns only
+    # what still exists)
+    assert len(t.drain()) == 8
+    t.record("e", 0.0, 0.001)
+    _, batch = t.spans_since(c0)
+    assert [s["name"] for s in batch] == ["e"]
+
+
+def test_tracer_shift_wall_anchor_moves_buffered_spans():
+    t = Tracer(process="t", enabled=True)
+    t.record("a", t._mono0, 0.001)
+    ts0 = t.spans[0]["ts_us"]
+    now0 = t.now_us()
+    t.shift_wall_anchor(123_456)
+    assert t.spans[0]["ts_us"] == ts0 + 123_456
+    assert t.now_us() - now0 >= 123_456
+
+
+def test_tracer_buffer_ops_survive_concurrent_recording():
+    """Regression: shift_wall_anchor / spans_since / drain iterate the
+    span buffer while hot-path threads append — a live-deque iteration
+    would raise RuntimeError and kill the connection worker applying a
+    clock_adjust mid-stream."""
+    t = Tracer(process="t", enabled=True)
+    stop = threading.Event()
+    errs: list = []
+
+    def recorder():
+        try:
+            while not stop.is_set():
+                t.record("hot", 0.0, 1e-6)
+        except BaseException as e:  # noqa: BLE001 — the regression
+            errs.append(e)
+
+    th = threading.Thread(target=recorder, daemon=True)
+    th.start()
+    try:
+        cursor = 0
+        deadline = time.monotonic() + 0.5
+        drained = 0
+        while time.monotonic() < deadline:
+            t.shift_wall_anchor(7)
+            cursor, batch = t.spans_since(cursor, limit=64)
+            t.chrome_events()
+            drained += len(t.drain())
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not errs, errs
+    assert drained > 0
+
+
+# ---------------------------------------------------------------------------
+# gauge inc/dec + watermark (satellite)
+# ---------------------------------------------------------------------------
+
+def test_gauge_inc_dec_and_watermark():
+    g = Gauge()
+    g.inc()
+    g.inc(2)
+    assert g.value == 3
+    g.dec()
+    assert g.value == 2
+    # watermark: peak since last take, resetting to current
+    assert g.take_watermark() == 3
+    assert g.take_watermark() == 2
+    g.set(7)
+    g.set(1)
+    assert g.take_watermark() == 7
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition hardening (satellite)
+# ---------------------------------------------------------------------------
+
+#: promtool-style line shapes for the text format
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|summary|histogram)$")
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*")*\})?'
+    r' -?[0-9.eE+naif]+$')
+
+
+def test_exposition_is_promtool_valid_with_hostile_names():
+    r = MetricsRegistry()
+    r.counter("transport.tx_frames").inc(7)
+    r.counter("1starts.with-digit").inc(1)
+    r.counter("weird name/with spaces").inc(2)
+    r.gauge("node.rx_queue_depth").set(3)
+    h = r.histogram("push.latency_s")
+    for v in (0.01, 0.02):
+        h.record(v)
+    r.register_callback("cb.metric", lambda: 1.5)
+    text = r.exposition()
+    assert text.endswith("\n")
+    names_with_help = set()
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP"):
+            assert _HELP_RE.match(line), line
+            names_with_help.add(line.split()[2])
+        elif line.startswith("# TYPE"):
+            assert _TYPE_RE.match(line), line
+            # every family announced a HELP line first
+            assert line.split()[2] in names_with_help, line
+        else:
+            assert _SAMPLE_RE.match(line), line
+    # sanitized names: legal charset, never digit-first
+    assert "_1starts_with_digit 1" in text
+    assert "weird_name_with_spaces 2" in text
+    # quantile labels survive as proper label values
+    assert 'push_latency_s{quantile="0.5"}' in text
+
+
+def test_prom_http_endpoint_serves_exposition():
+    r = MetricsRegistry()
+    r.counter("live.requests").inc(3)
+    srv = start_prom_server(0, registry=r)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "# TYPE live_requests counter" in body
+        assert "live_requests 3" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_obs_reporter_dead_thread_survives_is_alive_check():
+    """Regression: ``threading.Thread`` calls ``self._stop()`` as a
+    METHOD while checking a dead thread's ``is_alive()`` — an Event
+    attribute shadowing it broke every re-subscription after the first
+    subscriber disconnected."""
+    from defer_tpu.obs import ObsReporter
+    from defer_tpu.transport.framed import K_CTRL, recv_frame
+
+    class Src:
+        def obs_snapshot(self, *, cursor, include_spans, span_limit):
+            return {"node": {"stage": 0}, "processed": 0}, cursor
+
+    a, b = socket.socketpair()
+    rep = ObsReporter(Src(), a, interval_s=0.02)
+    rep.start()
+    kind, msg = recv_frame(b)
+    assert kind == K_CTRL and msg["cmd"] == "obs_push"
+    a.close()
+    b.close()
+    rep.join(timeout=10)
+    assert rep.is_alive() is False  # raised TypeError before the fix
+    rep.stop()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# clock alignment
+# ---------------------------------------------------------------------------
+
+def _probe_responder(sock, remote: Tracer):
+    """Answer clock_probe/clock_adjust like a StageNode would, against
+    ``remote``'s (possibly skewed) timeline."""
+    from defer_tpu.transport.framed import (K_CTRL, K_END, recv_frame,
+                                            send_ack, send_ctrl)
+    while True:
+        kind, msg = recv_frame(sock)
+        if kind == K_END:
+            return
+        assert kind == K_CTRL
+        if msg["cmd"] == "clock_probe":
+            send_ctrl(sock, {"cmd": "clock_probe_reply",
+                             "t_us": remote.now_us(),
+                             "echo": msg.get("echo")})
+        elif msg["cmd"] == "clock_adjust":
+            remote.shift_wall_anchor(int(msg["offset_us"]))
+            send_ack(sock)
+
+
+def test_clock_offset_estimator_with_injected_skew():
+    """The min-RTT ping-pong estimator recovers a known injected skew
+    (satellite: clock-offset unit test)."""
+    from defer_tpu.transport.framed import send_end
+
+    local = Tracer(process="disp")
+    remote = Tracer(process="node")
+    skew = 250_000  # 250 ms
+    remote.shift_wall_anchor(skew)
+    a, b = socket.socketpair()
+    t = threading.Thread(target=_probe_responder, args=(b, remote),
+                         daemon=True)
+    t.start()
+    try:
+        # residual anchor error: the two tracers sampled their wall/mono
+        # anchors at slightly different instants — sub-ms on one host
+        est = estimate_clock_offset(a, rounds=8, local=local)
+        assert est["offset_us"] == pytest.approx(skew, abs=5_000)
+        assert est["rtt_us"] >= 0
+        # align_clock ships the correction: afterwards the two timelines
+        # agree within the estimator's own error bound
+        align_clock(a, rounds=8, local=local)
+        assert abs(remote.now_us() - local.now_us()) < 5_000
+        send_end(a)
+        t.join(timeout=10)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_stage_node_clock_ctrl_roundtrip():
+    """StageNode answers clock_probe with its tracer timeline and
+    applies clock_adjust to the anchor (ACKed)."""
+    from defer_tpu.runtime.node import StageNode
+    from defer_tpu.transport.framed import (K_ACK, K_CTRL, recv_frame)
+
+    node = StageNode.__new__(StageNode)
+    node.prog = None
+    node.codec = "raw"
+    node.processed = 0
+    node.reweights = 0
+    node.address = ("127.0.0.1", 0)
+    node._pending_trace = None
+
+    tr = tracer()
+    wall0 = tr._wall0_us
+    a, b = socket.socketpair()
+    try:
+        before = tr.now_us()
+        assert node._handle_ctrl(a, {"cmd": "clock_probe", "echo": 3})
+        kind, reply = recv_frame(b)
+        assert kind == K_CTRL and reply["echo"] == 3
+        assert reply["t_us"] >= before
+        assert node._handle_ctrl(a, {"cmd": "clock_adjust",
+                                     "offset_us": -777})
+        kind, _ = recv_frame(b)
+        assert kind == K_ACK
+        assert tr._wall0_us == wall0 - 777
+    finally:
+        tr._wall0_us = wall0
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# ClusterView aggregation + straggler detection (synthetic pushes)
+# ---------------------------------------------------------------------------
+
+def _push(stage, *, processed, infer_ms=0.3, dec_ms=0.0, enc_ms=0.0,
+          rx_hi=0, tx_hi=0, replica=None, depth=8):
+    def summ(ms):
+        if ms <= 0:
+            return {"count": 0}
+        return {"count": 10, "p50": ms / 1e3, "p95": ms / 1e3,
+                "p99": ms / 1e3, "mean": ms / 1e3}
+    return {"cmd": "obs_push",
+            "node": {"stage": stage, "replica": replica, "fan_in": 1,
+                     "name": f"stage{stage}", "port": 5000 + stage},
+            "processed": processed,
+            "counters": {"tx_frames": processed, "tx_bytes": processed * 100,
+                         "rx_frames": processed, "rx_bytes": processed * 100},
+            "queues": {"rx_depth": depth, "tx_depth": depth, "rx": 0,
+                       "tx": 0, "rx_hi": rx_hi, "tx_hi": tx_hi,
+                       "inflight": 0, "merge": 0},
+            "latency": {"infer_s": summ(infer_ms), "decode_s": summ(dec_ms),
+                        "encode_s": summ(enc_ms), "rx_s": {"count": 0},
+                        "tx_s": {"count": 0}},
+            "trace": {"dropped": 0}}
+
+
+def test_cluster_view_rows_rates_and_timing_bottleneck():
+    view = ClusterView()
+    for i in range(3):
+        view.ingest(_push(0, processed=10 * (i + 1)), "a:1")
+        view.ingest(_push(1, processed=10 * (i + 1), dec_ms=12.0), "a:2")
+        view.ingest(_push(2, processed=10 * (i + 1)), "a:3")
+        time.sleep(0.02)
+    rows = view.rows()
+    assert [r["stage"] for r in rows] == [0, 1, 2]
+    assert all(r["processed"] == 30 for r in rows)
+    assert all(r["pushes"] == 3 for r in rows)
+    # delta-rate over the push window is positive and sane
+    assert all(r["throughput_per_s"] > 0 for r in rows)
+    # the decode-bound stage dominates the service estimate
+    assert rows[1]["service_ms"] == pytest.approx(12.0, rel=0.01)
+    assert view.bottleneck() == 1
+    assert view.stage_service_ms()[1] == pytest.approx(12.0, rel=0.01)
+    # stats_rows is replan-consumable (stage + infer summary)
+    srows = view.stats_rows()
+    assert {r["stage"] for r in srows} == {0, 1, 2}
+    assert all(r["infer_latency_s"]["count"] for r in srows)
+
+
+def test_cluster_view_backpressure_edge_fallback():
+    """With flat service times (a wire-bound hop: no CPU histogram sees
+    it), the saturation edge names the most-downstream stage the
+    backpressure points at."""
+    view = ClusterView()
+    for i in range(2):
+        # stage0 tx saturated (cannot drain into stage1), stage1 clear,
+        # stage2 starved: the edge stops at stage 1
+        view.ingest(_push(0, processed=10 * (i + 1), tx_hi=8), "a:1")
+        view.ingest(_push(1, processed=10 * (i + 1)), "a:2")
+        view.ingest(_push(2, processed=10 * (i + 1)), "a:3")
+    assert view.bottleneck() == 1
+
+
+def test_straggler_detector_sustained_slow_and_stalled():
+    view = ClusterView()
+    # interval 1: everything nominal
+    for s in range(3):
+        view.ingest(_push(s, processed=10), f"a:{s}")
+    det = StragglerDetector([0.3, 0.3, 0.3], factor=1.5, sustain=2)
+    assert det.observe(view) == []  # nothing sustained yet
+    # intervals 2..3: stage1 turns slow, stage2 stalls
+    for i in range(2, 4):
+        view.ingest(_push(0, processed=10 * i), "a:0")
+        view.ingest(_push(1, processed=10 * i, dec_ms=9.0), "a:1")
+        view.ingest(_push(2, processed=10), "a:2")
+    flags = {f.stage: f for f in det.observe(view)}
+    assert flags[1].reason == "slow"
+    assert flags[1].intervals == 2
+    assert flags[1].measured_ms == pytest.approx(9.0, rel=0.01)
+    assert flags[1].ratio == pytest.approx(30.0, rel=0.01)
+    assert flags[2].reason == "stalled"
+    assert 0 not in flags  # the healthy stage stays unflagged
+
+
+def test_straggler_suggest_names_the_slow_stage():
+    """The replan suggestion is driven by the live SERVICE estimate, so
+    a codec-bound straggler (invisible to infer-only latency) still
+    gets the largest correction."""
+    from defer_tpu import GraphBuilder
+    from defer_tpu.graph import ops
+    from defer_tpu.plan import StageCostModel, evaluate_cuts
+
+    b = GraphBuilder("3stage")
+    x = b.input((16,))
+    x = b.add(ops.Dense(16), x, name="n0")
+    x = b.add(ops.Dense(16), x, name="n1")
+    x = b.add(ops.Dense(16), x, name="n2")
+    g = b.build()
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e9,
+                        node_costs={"n0": 3e-4, "n1": 3e-4, "n2": 3e-4})
+    plan = evaluate_cuts(g, ["n0", "n1"], cm)
+    exp = expected_stage_ms(plan)
+    assert len(exp) == 3
+
+    view = ClusterView()
+    for i in range(1, 4):
+        view.ingest(_push(0, processed=8 * i, infer_ms=0.3), "a:0")
+        view.ingest(_push(1, processed=8 * i, infer_ms=0.3, enc_ms=10.0),
+                    "a:1")
+        view.ingest(_push(2, processed=8 * i, infer_ms=0.3), "a:2")
+    det = StragglerDetector(exp, factor=1.5, sustain=2)
+    flags = det.observe(view)
+    assert [f.stage for f in flags] == [1]
+    sugg = det.suggest(view, g, plan, cm)
+    corr = sugg.corrections
+    assert max(corr, key=lambda k: corr[k]) == 1
+    assert corr[1] > 10 * max(corr[0], corr[2])
+    # the result serializes (what monitor --json prints)
+    json.dumps(sugg.to_json())
+
+
+# ---------------------------------------------------------------------------
+# the obs_push path through a live (in-process) 3-node chain
+# ---------------------------------------------------------------------------
+
+def test_obs_push_three_node_chain_converges_with_stats():
+    """Satellite: a 3-node chain test asserting the ClusterView's
+    push-derived model converges to the nodes' own ``stats`` replies,
+    plus waterfall sampling producing queue-wait spans keyed on the
+    shared wire sequence."""
+    from defer_tpu import partition
+    from defer_tpu.models import resnet_tiny
+    from defer_tpu.runtime.node import ChainDispatcher, StageNode
+
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    stages = partition(g, num_stages=3)
+    nodes = [StageNode(None, "127.0.0.1:0", None) for _ in range(3)]
+    addrs = [f"127.0.0.1:{n.address[1]}" for n in nodes]
+    threads = [threading.Thread(target=n.serve, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+
+    tr = tracer()
+    was_enabled, old_proc = tr.enabled, tr.process
+    tr.clear()
+    try:
+        tr.enabled = True
+        tr.process = "dispatcher"
+        tr.start_trace()
+        disp = ChainDispatcher(addrs[0], codec="raw",
+                               trace_sample_every=4)
+        xs = [np.random.default_rng(0).standard_normal((2, 32, 32, 3))
+              .astype(np.float32) for _ in range(16)]
+        view = None
+        try:
+            # 15 ms per side: stage 1's service must dominate even when
+            # 1-core scheduling inflates the other stages' infer p50s
+            # (tracing + three nodes share this host's single core)
+            disp.deploy(stages, params, addrs, batch=2,
+                        codecs=["dsleep15+raw", "esleep15+raw", "raw"])
+            offsets = disp.align_clocks(addrs)
+            assert set(offsets) == set(addrs)
+            disp.stream(xs[:2])  # warm: compile transients
+            view = disp.watch(addrs, interval_ms=60)
+            outs = disp.stream(xs)
+            assert len(outs) == 16
+            stats = disp.stats(addrs)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                rows = view.rows()
+                if len(rows) == 3 and all(r["pushes"] >= 2 and
+                                          r["processed"] == 18
+                                          for r in rows):
+                    break
+                time.sleep(0.05)
+            rows = view.rows()
+            # CONVERGENCE: the push-derived model matches the stats
+            # replies — counts exactly, percentiles from the same
+            # per-node histogram
+            by_stage = {s["stage"]: s for s in stats
+                        if s.get("stage") is not None}
+            assert len(rows) == 3
+            for r in rows:
+                s = by_stage[r["stage"]]
+                assert r["processed"] == s["processed"]
+                assert r["infer_ms"]["p50"] == pytest.approx(
+                    s["infer_latency_s"]["p50"] * 1e3, rel=1e-6)
+            # the decode/encode-delayed stage is the live bottleneck
+            assert view.bottleneck() == 1
+            disp.collect_trace(addrs)
+        finally:
+            if view is not None:
+                view.close()
+            disp.close()
+        for t in threads:
+            t.join(timeout=60)
+        spans = tr.spans
+        names = {s["name"] for s in spans}
+        # waterfall sampling: queue-wait spans exist for sampled frames
+        assert any(n.endswith(".rx_wait") for n in names), sorted(names)
+        assert any(n.endswith(".tx_wait") for n in names), sorted(names)
+        # per-frame spans were SAMPLED: only multiples of 4 of the
+        # CONTINUOUS wire sequence (2 warm + 16 timed frames = seqs
+        # 0..17; sampling never reuses a seq across stream() calls)
+        stage_infer_seqs = {s["args"]["seq"] for s in spans
+                            if s["name"].endswith(".infer")
+                            and s["name"].startswith("stage")}
+        assert stage_infer_seqs == {0, 4, 8, 12, 16}, stage_infer_seqs
+    finally:
+        tr.enabled = was_enabled
+        tr.process = old_proc
+        tr._remote_parent = None
+        tr.clear()
+
+
+def test_measured_stage_seconds_source_forms():
+    """The direct {stage: seconds} mapping passes through; an
+    all-numeric registry snapshot (counters/gauges, dotted keys) must
+    fall through to the pattern search and yield {} — not crash
+    (regression)."""
+    from defer_tpu.plan import measured_stage_seconds
+
+    assert measured_stage_seconds({0: 0.01, "1": 0.02}) \
+        == {0: 0.01, 1: 0.02}
+    assert measured_stage_seconds(
+        {"transport.tx_frames": 5, "node.inflight": 1.0}) == {}
+
+
+# ---------------------------------------------------------------------------
+# plan_from_json round trip (monitor --plan input)
+# ---------------------------------------------------------------------------
+
+def test_plan_from_json_roundtrip():
+    from defer_tpu import GraphBuilder
+    from defer_tpu.graph import ops
+    from defer_tpu.plan import (StageCostModel, plan_from_json, solve,
+                                solve_replicated)
+
+    b = GraphBuilder("rt")
+    x = b.input((8,))
+    for i in range(4):
+        x = b.add(ops.Dense(8), x, name=f"n{i}")
+    g = b.build()
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e9,
+                        node_costs={f"n{i}": 1e-4 * (i + 1)
+                                    for i in range(4)})
+    plan = solve(g, 2, cm)
+    rt = plan_from_json(json.loads(json.dumps(plan.to_json())))
+    assert rt.cuts == plan.cuts
+    assert rt.num_stages == plan.num_stages
+    assert rt.bottleneck_s == pytest.approx(plan.bottleneck_s, rel=1e-4)
+    assert rt.bottleneck_stage == plan.bottleneck_stage
+    assert expected_stage_ms(rt) == pytest.approx(
+        expected_stage_ms(plan), rel=1e-4)
+    # replicated plans round-trip their replica counts too
+    rp = solve_replicated(g, cm, num_nodes=4)
+    rrt = plan_from_json(json.loads(json.dumps(rp.to_json())))
+    assert getattr(rrt, "replicas", None) == rp.replicas
+    assert expected_stage_ms(rrt) == pytest.approx(
+        expected_stage_ms(rp), rel=1e-4)
+    # a whole `plan --json` document (plan nested under "plan") works
+    assert plan_from_json({"plan": plan.to_json()}).cuts == plan.cuts
