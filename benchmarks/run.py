@@ -276,12 +276,19 @@ def run_script_row(script_name: str):
 #: outputs, >= 1.5x measured throughput, solver tie-in) and
 #: `obs_overhead` (live observability plane: monitor rows converge to
 #: node stats, bottleneck + straggler + replan name the delay-bound
-#: stage, clock-aligned waterfalls, telemetry wall overhead < 5%)
+#: stage, clock-aligned waterfalls, telemetry wall overhead < 5%) and
+#: `colocated_fastpath` (transport tiers: colocated chain — one OS
+#: process, local in-memory hops negotiated by the tier_probe handshake
+#: — byte-identical to the all-TCP chain and >= 1.5x faster on a
+#: codec-delay-bound chain; fused device hops eliminate the inter-stage
+#: frame entirely; rows record the NEGOTIATED tier per hop so BENCH_*
+#: trajectories distinguish TCP-bound from colocated/fused runs)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
     "stage_replication": "replication_smoke.py",
     "obs_overhead": "monitor_smoke.py",
+    "colocated_fastpath": "colocate_smoke.py",
 }
 
 

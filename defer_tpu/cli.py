@@ -106,6 +106,26 @@ def _add_cost_flags(p):
     p.add_argument("--calibrate", action="store_true",
                    help="micro-bench the codec table on this host "
                         "instead of using analytic defaults")
+    p.add_argument("--hop-tier-map", default="", metavar="CUT=TIER,...",
+                   help="declare colocated boundaries to the cost model "
+                        "(cut node name = local|device): those hops are "
+                        "scored on the tier pseudo-codec instead of the "
+                        "cheapest wire codec, so cut placement exploits "
+                        "colocation (docs/PLANNER.md)")
+
+
+def _parse_hop_tier_map(spec: str) -> dict | None:
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cut, sep, tier = part.rpartition("=")
+        if not sep or tier not in ("local", "device", "tcp"):
+            raise SystemExit(f"--hop-tier-map: {part!r} is not "
+                             f"CUT=local|device|tcp")
+        out[cut] = tier
+    return out or None
 
 
 def _cost_model(args, graph, *, node_costs=None):
@@ -120,7 +140,9 @@ def _cost_model(args, graph, *, node_costs=None):
         codecs = {n: DEFAULT_CODECS[n] for n in names}
     return StageCostModel(graph, batch=getattr(args, "batch", 1),
                           link_bw_s=args.link_bw or None,
-                          codecs=codecs, node_costs=node_costs)
+                          codecs=codecs, node_costs=node_costs,
+                          hop_tiers=_parse_hop_tier_map(
+                              getattr(args, "hop_tier_map", "")))
 
 
 def _partition_json(graph, stages, plan=None) -> dict:
@@ -449,29 +471,107 @@ def _start_prom(args, who: str):
           file=sys.stderr, flush=True)
 
 
+def _parse_co_stage(spec: str) -> dict:
+    """``listen=ADDR[;artifact=P][;next=A][;codec=C][;tier=T]
+    [;accept=0|1]`` -> dict.  The co-stage grammar uses ``;`` separators
+    because ``next`` values may themselves be comma lists (fan-out).
+    ``accept`` controls whether this housemate GRANTS inbound tier
+    offers (default: its own ``tier`` is not tcp) — independent of the
+    outbound policy because a stage whose next hop leaves the process
+    may still be the local-tier target of its upstream housemate."""
+    kv = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        k, sep, v = part.partition("=")
+        if not sep:
+            raise SystemExit(f"--co-stage: {part!r} is not key=value")
+        kv[k.strip()] = v.strip()
+    if "listen" not in kv:
+        raise SystemExit(f"--co-stage {spec!r} needs listen=host:port")
+    bad = set(kv) - {"listen", "artifact", "next", "codec", "tier",
+                     "accept"}
+    if bad:
+        raise SystemExit(f"--co-stage: unknown keys {sorted(bad)}")
+    if kv.get("accept") not in (None, "0", "1"):
+        raise SystemExit(f"--co-stage: accept must be 0|1, "
+                         f"got {kv['accept']!r}")
+    return kv
+
+
 def cmd_node(args):
+    import threading
+    import traceback
+
     from .runtime.node import StageNode
     from .transport.framed import _codec
 
     _apply_sock_buf(args)
     _start_prom(args, "node")
     _codec(args.codec)  # loud at boot, not when the first tensor relays
-    node = StageNode(args.artifact, args.listen, args.next,
-                     codec=args.codec, overlap=not args.no_overlap,
-                     rx_depth=args.rx_depth, tx_depth=args.tx_depth,
-                     inflight=args.inflight, fan_in=args.fan_in,
-                     replica=args.replica)
-    what = (f"stage {node.manifest['index']} ({node.manifest['name']})"
-            if node.manifest else "EMPTY (awaiting in-band deploy)")
-    if node.replica is not None:
-        what += f" replica {node.replica}"
-    if node.fan_in > 1:
-        what += f" fan-in {node.fan_in}"
-    print(f"node: {what} listening on "
-          f"{node.address[0]}:{node.address[1]}, next {args.next}"
-          f"{' [serial]' if args.no_overlap else ''}",
-          file=sys.stderr, flush=True)
+
+    def boot(artifact, listen, nxt, codec, tier, accept, primary):
+        # --fan-in/--replica describe the PRIMARY node's place in a fan
+        # topology; housemates always sit on plain local hops (the fan
+        # machinery is wire-framed, and colocation next to replication
+        # is rejected upstream), so they never inherit either flag
+        node = StageNode(artifact, listen, nxt,
+                         codec=codec, overlap=not args.no_overlap,
+                         rx_depth=args.rx_depth, tx_depth=args.tx_depth,
+                         inflight=args.inflight,
+                         fan_in=args.fan_in if primary else 1,
+                         replica=args.replica if primary else None,
+                         tier=tier, tier_accept=accept)
+        what = (f"stage {node.manifest['index']} "
+                f"({node.manifest['name']})"
+                if node.manifest else "EMPTY (awaiting in-band deploy)")
+        if node.replica is not None:
+            what += f" replica {node.replica}"
+        if node.fan_in > 1:
+            what += f" fan-in {node.fan_in}"
+        print(f"node: {what} listening on "
+              f"{node.address[0]}:{node.address[1]}, next {nxt}"
+              f"{' [serial]' if args.no_overlap else ''}",
+              file=sys.stderr, flush=True)
+        return node
+
+    # colocated stages: every --co-stage boards this process as its own
+    # serve thread — the hops between housemates negotiate the local
+    # (zero-serialization in-memory) transport tier (docs/TRANSPORT.md)
+    node = boot(args.artifact, args.listen, args.next, args.codec,
+                args.tier, args.tier != "tcp", True)
+    co = [boot(kv.get("artifact"), kv["listen"], kv.get("next"),
+               kv.get("codec", "raw"), kv.get("tier", args.tier),
+               kv["accept"] == "1" if "accept" in kv
+               else kv.get("tier", args.tier) != "tcp", False)
+          for kv in map(_parse_co_stage, args.co_stage or [])]
+    counts: dict[int, int] = {}
+
+    def serve_co(i: int):
+        try:
+            counts[i] = co[i].serve(
+                connect_timeout_s=args.connect_timeout)
+        except BaseException:  # noqa: BLE001 — a dead co-stage must
+            # kill the whole process so the parent sees one attributed
+            # failure instead of a wedged chain
+            import os
+            traceback.print_exc()
+            sys.stderr.flush()
+            os._exit(1)
+
+    threads = [threading.Thread(target=serve_co, args=(i,), daemon=True)
+               for i in range(len(co))]
+    for t in threads:
+        t.start()
     n = node.serve(connect_timeout_s=args.connect_timeout)
+    # the process exits only when EVERY housemate's stream has drained:
+    # the primary finishing first must not kill a co-stage mid-relay (a
+    # plain node blocks in serve() just the same; a wedged chain is the
+    # dispatcher's to kill)
+    for t in threads:
+        t.join()
+    n += sum(counts.values())
     print(f"node: served {n} tensors; chain drained", file=sys.stderr)
 
 
@@ -523,6 +623,7 @@ def cmd_chain(args):
           .astype(np.float32) for _ in range(args.count)]
 
     replicas = _parse_replicas(args.replicas)
+    hop_tiers = [t for t in args.hop_tiers.split(",") if t] or None
     _start_prom(args, "chain")
     stats: list = []
     t0 = time.perf_counter()
@@ -530,6 +631,7 @@ def cmd_chain(args):
                      in_band=args.in_band, overlap=not args.no_overlap,
                      rx_depth=args.rx_depth, tx_depth=args.tx_depth,
                      inflight=args.inflight, replicas=replicas or None,
+                     hop_tiers=hop_tiers, tier=args.tier,
                      stats_out=stats,
                      trace_sample_every=args.trace_sample)
     dt = time.perf_counter() - t0
@@ -537,14 +639,32 @@ def cmd_chain(args):
     fwd = jax.jit(graph.apply)
     worst = max(float(np.abs(np.asarray(fwd(params, x)) - y).max())
                 for x, y in zip(xs, outs))
+    # the NEGOTIATED transport tier per INTER-stage hop (stage order,
+    # one entry per deployed hop — a replicated stage's fan is one tcp
+    # policy) plus the last stage's result-hop tier, so bench
+    # trajectories distinguish TCP-bound from colocated/fused runs
+    tier_of: dict[int, str] = {}
+    for s in stats:
+        if s.get("stage") is not None:
+            tier_of.setdefault(int(s["stage"]), s.get("tier"))
+    order = sorted(tier_of)
+    # the DEPLOYED stage count: device-tier fusion merges stages before
+    # spawn, so the metric name / stage count must describe what ran —
+    # a fused single-program row labeled "3proc" would be exactly the
+    # TCP-vs-fused confusion the hop_tiers field exists to prevent
+    n_deployed = len(order) or len(stages)
     row = {
-        "metric": f"{args.model}_{len(stages)}proc_chain",
+        "metric": f"{args.model}_{n_deployed}proc_chain",
         "value": round(len(xs) * args.batch / dt, 3),
         "unit": "inferences/sec",
-        "stages": len(stages), "codec": args.codec,
+        "stages": n_deployed, "codec": args.codec,
         "overlap": not args.no_overlap,
+        "hop_tiers": [tier_of[k] for k in order[:-1]],
+        "result_tier": tier_of[order[-1]] if order else None,
         "max_abs_err_vs_single_program": worst,
     }
+    if n_deployed != len(stages):
+        row["stages_requested"] = len(stages)
     if replicas:
         row["replicas"] = {f"stage{k}": r
                            for k, r in sorted(replicas.items())}
@@ -561,15 +681,17 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
     tty = sys.stdout.isatty()
     if clear and tty:
         print("\x1b[2J\x1b[H", end="")
-    print(f"{'STAGE':>5} {'REP':>3} {'INF/S':>8} {'P50MS':>9} "
+    print(f"{'STAGE':>5} {'REP':>3} {'TIER':>5} {'INF/S':>8} {'P50MS':>9} "
           f"{'P95MS':>9} {'P99MS':>9} {'RXQ':>4} {'TXQ':>4} "
           f"{'RX^':>4} {'TX^':>4} {'INF':>4} {'RX B/S':>11} "
           f"{'TX B/S':>11} {'DONE':>8}  ADDR")
     for r in rows:
         stage = "-" if r["stage"] is None else str(r["stage"])
         rep = "-" if r["replica"] is None else str(r["replica"])
+        tier = (r.get("tier") or "-")[:5]
         p = r["infer_ms"]
-        line = (f"{stage:>5} {rep:>3} {r['throughput_per_s']:>8.1f} "
+        line = (f"{stage:>5} {rep:>3} {tier:>5} "
+                f"{r['throughput_per_s']:>8.1f} "
                 f"{p['p50']:>9.3f} {p['p95']:>9.3f} {p['p99']:>9.3f} "
                 f"{r['rx_q']:>4.0f} {r['tx_q']:>4.0f} "
                 f"{r['rx_hi']:>4.0f} {r['tx_hi']:>4.0f} "
@@ -865,6 +987,21 @@ def main(argv=None):
                     help="serve this process's metrics registry as a "
                          "Prometheus scrape endpoint on PORT "
                          "(0 = ephemeral, printed to stderr)")
+    nd.add_argument("--tier", choices=["auto", "tcp"], default="auto",
+                    help="outbound transport-tier policy: auto offers "
+                         "the colocated zero-serialization fast path on "
+                         "the downstream dial (degrading to tcp across "
+                         "processes); tcp is the pure-wire escape hatch "
+                         "— never probe, refuse inbound offers "
+                         "(docs/TRANSPORT.md)")
+    nd.add_argument("--co-stage", action="append", default=[],
+                    metavar="SPEC",
+                    help="host an additional stage node in THIS process "
+                         "(repeatable): 'listen=host:port[;artifact=P]"
+                         "[;next=host:port][;codec=C][;tier=T]"
+                         "[;accept=0|1]' — hops between housemates "
+                         "negotiate the local in-memory tier (accept "
+                         "gates inbound offers; default: tier != tcp)")
     _add_overlap_flags(nd)
 
     c = sub.add_parser("chain", help="spawn a local N-process chain and "
@@ -895,6 +1032,18 @@ def main(argv=None):
     c.add_argument("--prom-port", type=int, default=None, metavar="PORT",
                    help="serve the dispatcher process's metrics "
                         "registry as a Prometheus scrape endpoint")
+    c.add_argument("--tier", choices=["auto", "tcp"], default="auto",
+                   help="transport-tier policy for every hop: auto "
+                        "negotiates the colocated fast path where it "
+                        "holds (same process) and degrades to tcp "
+                        "elsewhere; tcp is the escape hatch — pure "
+                        "wire end to end (docs/TRANSPORT.md)")
+    c.add_argument("--hop-tiers", default="", metavar="T0,T1,...",
+                   help="per-inter-stage-hop tier list (len = stages-1, "
+                        "each tcp|auto|local|device): device FUSES the "
+                        "two stages into one jit program, local "
+                        "COLOCATES them in one OS process with an "
+                        "in-memory channel between them")
     _add_overlap_flags(c)
     _add_obs_flags(c)
 

@@ -297,6 +297,10 @@ class ClusterView:
                 "stage": node.ident.get("stage"),
                 "replica": node.ident.get("replica"),
                 "name": node.ident.get("name"),
+                # negotiated OUTBOUND transport tier of the node's hop
+                # (tcp / local / auto-until-negotiated) — distinguishes
+                # wire-bound rows from colocated fast-path ones
+                "tier": node.ident.get("tier"),
                 "addr": node.addr,
                 "pushes": len(node.history),
                 "age_s": round(now - t_last, 3),

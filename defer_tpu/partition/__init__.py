@@ -1,2 +1,2 @@
-from .partitioner import partition
+from .partitioner import fuse_stages, partition
 from .stage import StageSpec

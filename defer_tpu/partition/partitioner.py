@@ -77,3 +77,37 @@ def partition(graph: LayerGraph, cut_points: list[str] | None = None,
             out_spec=graph.out_spec(end),
         ))
     return stages
+
+
+def fuse_stages(stages: "list[StageSpec]", hop_tiers: "list[str]"
+                ) -> "tuple[list[StageSpec], list[list[int]]]":
+    """Collapse every ``device``-tier hop: adjacent stages that land on
+    one device compile into a SINGLE jit stage program instead of paying
+    a frame + dispatch per boundary (the MPK mega-kernelization
+    direction, PAPERS.md).
+
+    Because a stage is a contiguous graph slice, fusing stages ``k`` and
+    ``k+1`` is exactly re-partitioning WITHOUT the cut between them —
+    the merged slice exports/compiles as one StableHLO program, so the
+    hop (its frame, its queue, its codec) ceases to exist rather than
+    being made cheap.
+
+    ``hop_tiers`` has one entry per inter-stage hop (len =
+    ``len(stages) - 1``); every ``"device"`` entry fuses its two sides.
+    Returns ``(fused_stages, groups)`` where ``groups[j]`` lists the
+    ORIGINAL stage indices merged into fused stage ``j`` — callers remap
+    per-stage attributes (hop codecs, replica counts) through it.
+    """
+    if len(hop_tiers) != len(stages) - 1:
+        raise ValueError(f"{len(stages)} stages need {len(stages) - 1} "
+                         f"hop tiers, got {len(hop_tiers)}")
+    groups: list[list[int]] = [[0]]
+    for k, tier in enumerate(hop_tiers):
+        if tier == "device":
+            groups[-1].append(k + 1)
+        else:
+            groups.append([k + 1])
+    if len(groups) == len(stages):
+        return list(stages), groups  # nothing to fuse
+    keep = [stages[g[-1]].output_name for g in groups[:-1]]
+    return partition(stages[0].graph, keep), groups

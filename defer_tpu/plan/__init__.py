@@ -20,7 +20,7 @@ the real objective:
 See ``docs/PLANNER.md`` for the model and the recurrence.
 """
 
-from .cost import (CodecSpec, DEFAULT_CODECS, StageCostModel,
+from .cost import (CodecSpec, DEFAULT_CODECS, TIER_CODECS, StageCostModel,
                    bench_codec_instance, bench_codec_spec,
                    calibrate_codecs)
 from .replan import (ReplanResult, corrected_cost_model,
@@ -31,7 +31,7 @@ from .solver import (Plan, ReplicatedPlan, brute_force,
                      sweep_nodes, sweep_stages)
 
 __all__ = [
-    "CodecSpec", "DEFAULT_CODECS", "StageCostModel",
+    "CodecSpec", "DEFAULT_CODECS", "TIER_CODECS", "StageCostModel",
     "bench_codec_instance", "bench_codec_spec", "calibrate_codecs",
     "Plan", "solve", "evaluate_cuts", "sweep_stages", "brute_force",
     "ReplicatedPlan", "solve_replicated", "brute_force_replicated",

@@ -30,6 +30,7 @@ the compute side with live telemetry.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import time
 
@@ -82,6 +83,53 @@ DEFAULT_CODECS: dict[str, CodecSpec] = {
     "bf16": CodecSpec("bf16", ratio=2.0, encode_bytes_per_s=1.5e8,
                       decode_bytes_per_s=2.5e8, lossy=True),
 }
+
+#: transport-tier PSEUDO-codecs (docs/TRANSPORT.md tier matrix): the comm
+#: model of a colocated hop.  These never enter the per-hop codec argmin
+#: (every hop would trivially "choose" them) — they are selected by the
+#: hop-tier map (``StageCostModel(hop_tiers=...)``) and REPLACE the codec
+#: trade on hops the deployment declares colocated:
+#:
+#: * ``local`` — same process, in-memory channel: zero encode/decode
+#:   (the array passes by reference), wire term = one memory-bandwidth
+#:   pass over the boundary bytes (the queue handoff's cache/allocator
+#:   cost — ``DEFAULT_LOCAL_BW_S``, override with ``local_bw_s=``).
+#: * ``device`` — the stages fuse into one jit program
+#:   (``partition.fuse_stages``): the hop does not exist; ~0 seconds.
+TIER_CODECS: dict[str, CodecSpec] = {
+    "local": CodecSpec("local", ratio=1.0, encode_bytes_per_s=0.0,
+                       decode_bytes_per_s=0.0),
+    "device": CodecSpec("device", ratio=1.0, encode_bytes_per_s=0.0,
+                        decode_bytes_per_s=0.0),
+}
+
+#: host memory bandwidth for the ``local`` pseudo-codec's wire term —
+#: one DRAM-class pass over the boundary tensor (order-of-magnitude;
+#: the planner needs relative weights, and ~10 GB/s keeps a colocated
+#: hop 2-3 decades under any TCP hop without rounding it to free).
+DEFAULT_LOCAL_BW_S = 1e10
+
+
+def _check_hop_tiers(graph: LayerGraph,
+                     hop_tiers: dict[str, str] | None) -> dict[str, str]:
+    """Validate a hop-tier map: known tier names AND real cut-point
+    keys — a misspelled cut silently scoring as tcp would make the
+    planner model a topology the caller never declared (same loud-miss
+    policy as the constructor's ``node_costs`` check)."""
+    if not hop_tiers:
+        return {}
+    bad = [t for t in hop_tiers.values() if t not in ("tcp", *TIER_CODECS)]
+    if bad:
+        raise ValueError(f"unknown hop tiers {bad}; "
+                         f"use tcp|{'|'.join(TIER_CODECS)}")
+    from ..graph.analysis import valid_cut_points
+    valid = set(valid_cut_points(graph))
+    missing = [c for c in hop_tiers if c not in valid]
+    if missing:
+        raise ValueError(
+            f"hop_tiers name cuts that are not valid cut points of "
+            f"{graph.name!r}: {missing[:5]}")
+    return dict(hop_tiers)
 
 
 def bench_codec_instance(codec, payload: np.ndarray, *,
@@ -145,6 +193,15 @@ class StageCostModel:
     numbers off-TPU so relative weights stay sane).  ``link_bw_s`` is the
     hop bandwidth in bytes/s; ``codecs`` the candidate
     :class:`CodecSpec` table per hop.
+
+    ``hop_tiers`` (cut name -> ``"local"``/``"device"``, anything
+    absent = ``"tcp"``) declares which boundaries the deployment
+    colocates: those hops cost their :data:`TIER_CODECS` pseudo-codec
+    instead of the cheapest wire codec, so cut placement EXPLOITS
+    colocation (a fat boundary is free to cross on a fused hop) instead
+    of modeling every boundary as a TCP hop.  ``local_bw_s`` sets the
+    ``local`` tier's memory-bandwidth wire term
+    (:data:`DEFAULT_LOCAL_BW_S`).
     """
 
     def __init__(self, graph: LayerGraph, *, batch: int = 1,
@@ -154,7 +211,9 @@ class StageCostModel:
                  link_bw_s: float | None = None,
                  codecs: dict[str, CodecSpec] | None = None,
                  node_costs: dict[str, float] | None = None,
-                 lossless_only: bool = False):
+                 lossless_only: bool = False,
+                 hop_tiers: dict[str, str] | None = None,
+                 local_bw_s: float | None = None):
         self.graph = graph
         self.batch = max(int(batch), 1)
         if gen is None:
@@ -179,6 +238,8 @@ class StageCostModel:
                 raise ValueError(
                     f"node_costs missing nodes: {missing[:5]}...")
         self.node_costs = dict(node_costs) if node_costs else None
+        self.hop_tiers = _check_hop_tiers(graph, hop_tiers)
+        self.local_bw_s = local_bw_s or DEFAULT_LOCAL_BW_S
 
     @staticmethod
     def _detect_gen() -> str:
@@ -221,18 +282,53 @@ class StageCostModel:
         spec = self.graph.out_spec(cut)
         return spec.size * spec.dtype.itemsize * self.batch
 
+    def hop_tier(self, cut: str) -> str:
+        """Declared transport tier of the hop at ``cut`` (default tcp)."""
+        return self.hop_tiers.get(cut, "tcp")
+
+    def with_hop_tiers(self, hop_tiers: dict[str, str] | None
+                       ) -> "StageCostModel":
+        """A shallow copy scoring hops under ``hop_tiers`` — how
+        ``solve(..., hop_tiers=...)`` threads a deployment's tier map
+        through without mutating the caller's model."""
+        other = copy.copy(self)
+        other.hop_tiers = _check_hop_tiers(self.graph, hop_tiers)
+        return other
+
+    def _tier_parts(self, cut: str, tier: str
+                    ) -> tuple[float, float, float]:
+        """(encode, wire, decode) seconds of a colocated hop: zero codec
+        work on both sides; ``local`` pays one memory-bandwidth pass
+        over the boundary bytes, ``device`` (a fused program) nothing."""
+        if tier == "device":
+            return 0.0, 0.0, 0.0
+        return TIER_CODECS["local"].comm_parts(self.cut_bytes(cut),
+                                               self.local_bw_s)
+
     def comm_seconds(self, cut: str, codec: str) -> float:
+        if codec in TIER_CODECS:
+            return sum(self._tier_parts(cut, codec))
         return self.codecs[codec].comm_seconds(self.cut_bytes(cut),
                                                self.link_bw_s)
 
     def best_codec(self, cut: str) -> tuple[str, float]:
-        """Cheapest (codec name, comm seconds) for the hop at ``cut``."""
+        """Cheapest (codec name, comm seconds) for the hop at ``cut``.
+
+        A cut whose declared tier is ``local``/``device`` skips the wire
+        codec argmin entirely — the tier's pseudo-codec IS the hop's
+        transport, and its name lands in the plan's ``hop_codecs`` so a
+        plan row shows which hops ride the fast path."""
+        tier = self.hop_tier(cut)
+        if tier in TIER_CODECS:
+            return tier, sum(self._tier_parts(cut, tier))
         return min(((n, self.comm_seconds(cut, n)) for n in self.codecs),
                    key=lambda kv: kv[1])
 
     def comm_parts(self, cut: str, codec: str
                    ) -> tuple[float, float, float]:
         """(encode, wire, decode) seconds for ``codec`` at ``cut``."""
+        if codec in TIER_CODECS:
+            return self._tier_parts(cut, codec)
         return self.codecs[codec].comm_parts(self.cut_bytes(cut),
                                              self.link_bw_s)
 
@@ -243,17 +339,27 @@ class StageCostModel:
         downstream ``r_down``: the encode side is paid by r_up processes
         in parallel, the decode side by r_down, and the wire serializes
         at the fan's single endpoint — ``enc/r_up + wire + dec/r_down``.
+
+        Tier interaction: a colocated tier only applies when NEITHER
+        side is replicated (the runtime's fan paths always ride tcp — a
+        fan-out cannot hand one live array to R processes); replicated
+        hops fall back to the wire-codec argmin.
         """
+        tier = self.hop_tier(cut)
+        if tier in TIER_CODECS and max(r_up, 1) == 1 \
+                and max(r_down, 1) == 1:
+            return tier, sum(self._tier_parts(cut, tier))
         best_name, best = None, float("inf")
         for n in self.codecs:
-            enc, wire, dec = self.comm_parts(cut, n)
+            enc, wire, dec = self.codecs[n].comm_parts(
+                self.cut_bytes(cut), self.link_bw_s)
             s = enc / max(r_up, 1) + wire + dec / max(r_down, 1)
             if s < best:
                 best_name, best = n, s
         return best_name, best
 
     def describe(self) -> dict:
-        return {
+        d = {
             "gen": self.gen, "batch": self.batch,
             "peak_flops_s": self.peak_flops_s, "hbm_bw_s": self.hbm_bw_s,
             "link_bw_s": self.link_bw_s,
@@ -261,3 +367,7 @@ class StageCostModel:
             "codecs": {n: dataclasses.asdict(c)
                        for n, c in self.codecs.items()},
         }
+        if self.hop_tiers:
+            d["hop_tiers"] = dict(sorted(self.hop_tiers.items()))
+            d["local_bw_s"] = self.local_bw_s
+        return d

@@ -145,7 +145,18 @@ def cost_model_from_plan(graph: LayerGraph, plan: Plan) -> StageCostModel:
         per = plan.stage_compute_s[k] / max(1, len(names))
         for n in names:
             node_costs[n] = per
-    return StageCostModel(graph, node_costs=node_costs)
+    # adopt the plan's per-hop transport tiers: a replan seeded from
+    # plan JSON keeps scoring the deployment's colocated hops on their
+    # tier pseudo-codecs instead of re-modeling them as TCP
+    tiers = {c: t for c, t in zip(plan.cuts,
+                                  getattr(plan, "hop_tiers", None) or [])
+             if t != "tcp"}
+    return StageCostModel(
+        graph, node_costs=node_costs, hop_tiers=tiers or None,
+        # the tier map's bandwidth half travels in the plan's cost_model
+        # dict — without it a calibrated local_bw_s would silently reset
+        # to the default in replans seeded from plan JSON
+        local_bw_s=(plan.cost or {}).get("local_bw_s"))
 
 
 def corrected_cost_model(graph: LayerGraph, plan: Plan,
@@ -171,7 +182,11 @@ def corrected_cost_model(graph: LayerGraph, plan: Plan,
         graph, batch=cost.batch, gen=cost.gen,
         peak_flops_s=cost.peak_flops_s, hbm_bw_s=cost.hbm_bw_s,
         link_bw_s=cost.link_bw_s, codecs=cost.codecs,
-        node_costs=node_costs)
+        node_costs=node_costs,
+        # tier-aware costs survive the correction: colocated hops stay
+        # colocated in the re-solve
+        hop_tiers=getattr(cost, "hop_tiers", None) or None,
+        local_bw_s=getattr(cost, "local_bw_s", None))
 
 
 def replan(graph: LayerGraph, plan: Plan, source,

@@ -50,6 +50,9 @@ class Plan:
     bottleneck_s: float
     objective: str
     cost: dict                     #: StageCostModel.describe()
+    #: per-hop transport tier (tcp|local|device, len == len(cuts)) —
+    #: which hops the cost model scored on the colocated fast path
+    hop_tiers: list[str] = dataclasses.field(default_factory=list)
 
     @property
     def stage_cost_s(self) -> list[float]:
@@ -81,6 +84,8 @@ class Plan:
             "num_stages": self.num_stages,
             "cuts": list(self.cuts),
             "hop_codecs": list(self.codecs),
+            "hop_tiers": list(self.hop_tiers)
+            or ["tcp"] * len(self.cuts),
             "stage_compute_ms": [round(s * 1e3, 6)
                                  for s in self.stage_compute_s],
             "hop_comm_ms": [round(s * 1e3, 6) for s in self.hop_comm_s],
@@ -125,19 +130,25 @@ def _mk_plan(graph, cost, chosen_idx, cuts, cum, total, comm,
                 cuts=[cuts[i] for i in chosen_idx], codecs=codecs,
                 stage_compute_s=stage_compute, hop_comm_s=hop_comm,
                 bottleneck_s=bottleneck, objective=objective,
-                cost=cost.describe())
+                cost=cost.describe(),
+                hop_tiers=[cost.hop_tier(cuts[i]) for i in chosen_idx])
 
 
 def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
                   cost: StageCostModel, *,
                   objective: str = "explicit",
-                  replicas: list[int] | None = None) -> Plan:
+                  replicas: list[int] | None = None,
+                  hop_tiers: dict[str, str] | None = None) -> Plan:
     """Predictions for an *explicit* cut list under ``cost`` (cheapest
     codec per hop) — how quantile or hand-picked cuts score on the same
     model the solver optimizes.  ``replicas`` (one count per stage)
     scores a replicated configuration instead: per-stage compute divides
     by its count and each hop's codec is re-chosen for the fan-adjusted
-    ``enc/r_up + wire + dec/r_down`` cost."""
+    ``enc/r_up + wire + dec/r_down`` cost.  ``hop_tiers`` (cut ->
+    tcp|local|device) scores colocated hops on their tier pseudo-codec
+    (:meth:`StageCostModel.with_hop_tiers`)."""
+    if hop_tiers is not None:
+        cost = cost.with_hop_tiers(hop_tiers)
     cuts, cum, total, comm = _tables(graph, cost)
     pos = {c: i for i, c in enumerate(cuts)}
     missing = [c for c in cut_points if c not in pos]
@@ -152,8 +163,17 @@ def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
 
 
 def solve(graph: LayerGraph, num_stages: int, cost: StageCostModel, *,
-          method: str = "dp") -> Plan:
-    """Optimal bottleneck plan for exactly ``num_stages`` stages."""
+          method: str = "dp",
+          hop_tiers: dict[str, str] | None = None) -> Plan:
+    """Optimal bottleneck plan for exactly ``num_stages`` stages.
+
+    ``hop_tiers`` (cut -> tcp|local|device) lets cut placement exploit
+    colocation: a cut whose hop is declared local/device costs its tier
+    pseudo-codec (near zero) instead of the cheapest wire codec, so the
+    solver is free to place cuts at fat boundaries the deployment
+    crosses for free (docs/PLANNER.md)."""
+    if hop_tiers is not None:
+        cost = cost.with_hop_tiers(hop_tiers)
     if num_stages < 1:
         raise ValueError("num_stages must be >= 1")
     cuts, cum, total, comm = _tables(graph, cost)
@@ -358,7 +378,8 @@ def plan_from_json(doc: dict) -> "Plan":
         hop_comm_s=[v / 1e3 for v in doc.get("hop_comm_ms", [])],
         bottleneck_s=float(doc["bottleneck_ms"]) / 1e3,
         objective=doc.get("objective", "explicit"),
-        cost=doc.get("cost_model", {}))
+        cost=doc.get("cost_model", {}),
+        hop_tiers=list(doc.get("hop_tiers", [])))
     if doc.get("replicas"):
         return ReplicatedPlan(**kw, replicas=list(doc["replicas"]),
                               num_nodes=int(doc.get("num_nodes", 0)))
@@ -435,17 +456,24 @@ def _mk_replicated_plan(graph, cost, chosen_idx, cuts, cum, total,
     eff = [c / r for c, r in zip(stage_compute, replicas)]
     bottleneck = max([max(c, hop_comm[k]) if k < len(hop_comm) else c
                       for k, c in enumerate(eff)] or [0.0])
+    # a tier only holds when neither side fans (runtime constraint —
+    # see StageCostModel.best_codec_replicated); report what was scored
+    tiers = [cost.hop_tier(cuts[i])
+             if replicas[k] == 1 and replicas[k + 1] == 1 else "tcp"
+             for k, i in enumerate(chosen_idx)]
     return ReplicatedPlan(
         graph_name=graph.name, num_stages=len(chosen_idx) + 1,
         cuts=[cuts[i] for i in chosen_idx], codecs=codecs,
         stage_compute_s=stage_compute, hop_comm_s=hop_comm,
         bottleneck_s=bottleneck, objective=objective,
         cost=cost.describe(), replicas=list(replicas),
-        num_nodes=sum(replicas))
+        num_nodes=sum(replicas), hop_tiers=tiers)
 
 
 def solve_replicated(graph: LayerGraph, cost: StageCostModel, *,
-                     num_nodes: int) -> ReplicatedPlan:
+                     num_nodes: int,
+                     hop_tiers: dict[str, str] | None = None
+                     ) -> ReplicatedPlan:
     """Jointly optimal cuts AND per-stage replica counts for a budget of
     ``num_nodes`` processes, minimizing::
 
@@ -463,7 +491,14 @@ def solve_replicated(graph: LayerGraph, cost: StageCostModel, *,
     O(C² · N³) dynamic program over (last cut, nodes used, last stage's
     replica count); cross-checked against
     :func:`brute_force_replicated` in the property tests.
+
+    ``hop_tiers`` (cut -> tcp|local|device): colocated hops cost their
+    tier pseudo-codec whenever neither side is replicated (fan paths
+    always ride tcp), so the joint DP trades replicas against fused or
+    same-process boundaries on one objective.
     """
+    if hop_tiers is not None:
+        cost = cost.with_hop_tiers(hop_tiers)
     if num_nodes < 1:
         raise ValueError("num_nodes must be >= 1")
     N = num_nodes

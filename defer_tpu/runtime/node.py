@@ -99,6 +99,18 @@ class StageNode:
     fan_in: int = 1
     replica: int | None = None
     next_hops: list[tuple[str, int]] | None = None
+    #: outbound transport-tier policy (docs/TRANSPORT.md): "auto" offers
+    #: the colocated fast path on the downstream dial (a tier_probe
+    #: handshake that silently degrades to tcp when the peer is another
+    #: process); "tcp" never probes — the status-quo wire path
+    tier: str = "tcp"
+    #: answer inbound tier probes (False = refuse every offer: the hop
+    #: degrades to tcp with the sender's fallback counter bumped)
+    tier_accept: bool = True
+    #: negotiated tiers, for stats/obs ("local"/"tcp"; None = no data
+    #: path yet)
+    tier_out: str | None = None
+    tier_in: str | None = None
     #: waterfall sampling period carried by the trace context (0 = every
     #: frame records spans, N >= 1 = only wire-seq multiples of N)
     trace_sample_every: int = 0
@@ -116,7 +128,8 @@ class StageNode:
                  next_hop: str | None, *, codec: str = "raw",
                  overlap: bool = True, rx_depth: int = 8,
                  tx_depth: int = 8, inflight: int = 2,
-                 fan_in: int = 1, replica: int | None = None):
+                 fan_in: int = 1, replica: int | None = None,
+                 tier: str = "tcp", tier_accept: bool = True):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
         # process exists
@@ -135,6 +148,12 @@ class StageNode:
         self.inflight = max(1, inflight)
         self.fan_in = max(1, fan_in)
         self.replica = replica
+        if tier not in ("tcp", "auto"):
+            raise ValueError(f"tier must be tcp|auto, got {tier!r}")
+        self.tier = tier
+        self.tier_accept = tier_accept
+        self.tier_out = None
+        self.tier_in = None
         self.processed = 0    # tensors relayed, lifetime
         self.reweights = 0    # weights-only re-pushes accepted
         #: trace-context K_CTRL received from upstream, held until this
@@ -180,18 +199,36 @@ class StageNode:
         or a :class:`FanOutSender` round-robining across a replicated
         downstream stage (announced with a ``stream_begin`` control
         frame so even a replica that ends up with zero frames knows it
-        is on the data path)."""
+        is on the data path).
+
+        With ``tier="auto"`` a single (non-fan) hop first offers the
+        colocated fast path (``transport.local.offer_local``): granted,
+        frames ride an in-memory :class:`LocalPipe` with zero
+        serialization and the socket stays open only as the hop's
+        lifetime anchor; refused, the hop degrades to the status-quo
+        wire path.  Fan-out and replica dial-backs never probe — the
+        ordered fan machinery is wire-framed by design."""
         if not self.next_hops:
             raise ValueError("no next hop configured")
         socks = [_connect_retry(*h, timeout_s=connect_timeout_s)
                  for h in self.next_hops]
         if len(socks) == 1:
-            tx = AsyncSender(socks[0], depth=self.tx_depth,
-                             codec=self.codec,
-                             gauge="node.tx_queue_depth",
-                             span=self._span_label,
-                             hist="node.tx_s")
+            tx = None
+            if self.tier == "auto" and self.replica is None:
+                from ..transport.local import offer_local
+                self.tier_out, pipe = offer_local(socks[0],
+                                                  depth=self.tx_depth)
+                if pipe is not None:
+                    tx = pipe.sender
+            if tx is None:
+                self.tier_out = "tcp"
+                tx = AsyncSender(socks[0], depth=self.tx_depth,
+                                 codec=self.codec,
+                                 gauge="node.tx_queue_depth",
+                                 span=self._span_label,
+                                 hist="node.tx_s")
         else:
+            self.tier_out = "tcp"
             tx = FanOutSender(socks, depth=self.tx_depth,
                               codec=self.codec,
                               gauge="node.tx_queue_depth",
@@ -269,6 +306,15 @@ class StageNode:
                 self.fan_in = max(1, int(msg["fan_in"]))
             if msg.get("replica") is not None:
                 self.replica = int(msg["replica"])
+            if msg.get("tier"):
+                # outbound transport-tier policy rides the deploy
+                # handshake, like the hop codec
+                if msg["tier"] not in ("tcp", "auto"):
+                    raise ValueError(f"deploy: tier must be tcp|auto, "
+                                     f"got {msg['tier']!r}")
+                self.tier = msg["tier"]
+            if msg.get("tier_accept") is not None:
+                self.tier_accept = bool(msg["tier_accept"])
             send_ack(conn)
             return True
         if cmd == "reweight":
@@ -335,6 +381,10 @@ class StageNode:
                 "processed": self.processed,
                 "reweights": self.reweights,
                 "codec": self.codec,
+                # negotiated outbound transport tier ("local"/"tcp";
+                # the configured policy until a data path negotiates)
+                "tier": self.tier_out or self.tier,
+                "tier_in": self.tier_in,
                 "next": None if not self.next_hops
                 else ",".join(f"{h}:{p}" for h, p in self.next_hops),
                 # wire telemetry: this node's process-local transport view
@@ -413,7 +463,9 @@ class StageNode:
             "node": {"stage": None if m is None else m["index"],
                      "name": None if m is None else m["name"],
                      "replica": self.replica, "fan_in": self.fan_in,
-                     "port": self.address[1], "codec": self.codec},
+                     "port": self.address[1], "codec": self.codec,
+                     "tier": self.tier_out or self.tier,
+                     "tier_in": self.tier_in},
             "processed": self.processed,
             "reweights": self.reweights,
             "counters": {
@@ -623,6 +675,22 @@ class StageNode:
                             and value.get("cmd") == "stream_begin":
                         stream_marked = True
                         continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "tier_probe":
+                        # colocated-tier handshake: granted, the data
+                        # path SWAPS to the offered in-memory pipe (the
+                        # socket stays as the hop's lifetime anchor);
+                        # refused, the stream continues on this socket
+                        from ..transport.local import answer_probe
+                        pipe = answer_probe(conn, value,
+                                            accept=self.tier_accept)
+                        if pipe is not None:
+                            rx = pipe.receiver
+                            rx.sample_every = self.trace_sample_every
+                            self.tier_in = "local"
+                        else:
+                            self.tier_in = "tcp"
+                        continue
                     is_trace = (isinstance(value, dict)
                                 and value.get("cmd") == "trace")
                     if is_trace:
@@ -683,6 +751,10 @@ class StageNode:
             rx.release_gauge()
             if pending:
                 inflight_g.dec(len(pending))
+            if tx is not None and hasattr(tx, "detach"):
+                # local-tier tx: a stream abandoned without its END must
+                # fail the downstream consumer like a cut socket would
+                tx.detach()
             if out_socks is not None:
                 for s in out_socks:
                     s.close()
@@ -714,6 +786,15 @@ class StageNode:
                     if isinstance(value, dict) \
                             and value.get("cmd") == "stream_begin":
                         stream_marked = True
+                        continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "tier_probe":
+                        # the serial baseline loop is the measurable
+                        # pure-wire reference: always refuse the fast
+                        # path (the offering hop degrades to tcp)
+                        from ..transport.local import answer_probe
+                        answer_probe(conn, value, accept=False)
+                        self.tier_in = "tcp"
                         continue
                     self._handle_ctrl(conn, value)
                     if (isinstance(value, dict)
@@ -807,6 +888,13 @@ class StageNode:
                         if not registered:
                             registered = True
                             self._ensure_merge_loop(connect_timeout_s)
+                        continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "tier_probe":
+                        # fan paths are wire-framed by design (ordered
+                        # seq merge): refuse, the offer degrades to tcp
+                        from ..transport.local import answer_probe
+                        answer_probe(conn, value, accept=False)
                         continue
                     self._handle_ctrl(conn, value)
                     if registered and isinstance(value, dict) \
@@ -980,6 +1068,14 @@ class ChainDispatcher:
     tx_depth: int = 8
     rx_depth: int = 8
     result_fan_in: int = 1
+    #: outbound tier policy for the dispatcher -> stage-0 hop ("auto"
+    #: offers the colocated fast path; "tcp" never) — also gates whether
+    #: the result server GRANTS the last node's inbound offer
+    tier: str = "tcp"
+    tier_accept: bool = True
+    #: negotiated tiers for reporting (first hop / result hop)
+    tier_out: str | None = None
+    tier_in: str | None = None
     #: waterfall sampling period (docs/OBSERVABILITY.md): with tracing
     #: enabled and N >= 1, every tensor frame is stamped with its stream
     #: sequence number and only 1-in-N frames record per-frame spans —
@@ -999,9 +1095,20 @@ class ChainDispatcher:
                  timeout_s: float | None = None,
                  tx_depth: int = 8, rx_depth: int = 8,
                  result_fan_in: int = 1,
-                 trace_sample_every: int = 0):
+                 trace_sample_every: int = 0,
+                 tier: str = "tcp", tier_accept: bool | None = None):
         if timeout_s is not None:
             self.timeout_s = timeout_s
+        if tier not in ("tcp", "auto"):
+            raise ValueError(f"tier must be tcp|auto, got {tier!r}")
+        self.tier = tier
+        #: default: grant result-hop offers exactly when this dispatcher
+        #: itself plays the colocated game ("--tier tcp" forces a pure
+        #: wire chain end to end)
+        self.tier_accept = (tier == "auto") if tier_accept is None \
+            else tier_accept
+        self.tier_out = None
+        self.tier_in = None
         host, port = _parse_hostport(listen)
         self._res_srv = socket.create_server((host, port))
         # a dead chain fails, not hangs
@@ -1044,6 +1151,7 @@ class ChainDispatcher:
             # feed loop's np.asarray and the wire overlap (and the END in
             # close() rides the same ordered queue)
             if self._send_socks is not None:
+                self.tier_out = "tcp"  # fan-out rides the wire
                 self._tx_chan = FanOutSender(self._send_socks,
                                              depth=self.tx_depth,
                                              codec=self.codec,
@@ -1052,12 +1160,21 @@ class ChainDispatcher:
                                              hist="chain.tx_s")
                 self._tx_chan.send_ctrl({"cmd": "stream_begin"})
             else:
-                self._tx_chan = AsyncSender(self._send_sock,
-                                            depth=self.tx_depth,
-                                            codec=self.codec,
-                                            gauge="chain.tx_queue_depth",
-                                            span="chain",
-                                            hist="chain.tx_s")
+                if self.tier == "auto":
+                    # offer the colocated fast path on the stage-0 hop;
+                    # a cross-process node refuses and we stay on tcp
+                    from ..transport.local import offer_local
+                    self.tier_out, pipe = offer_local(
+                        self._send_sock, depth=self.tx_depth)
+                    if pipe is not None:
+                        self._tx_chan = pipe.sender
+                if self._tx_chan is None:
+                    self.tier_out = "tcp"
+                    self._tx_chan = AsyncSender(
+                        self._send_sock, depth=self.tx_depth,
+                        codec=self.codec,
+                        gauge="chain.tx_queue_depth",
+                        span="chain", hist="chain.tx_s")
             self._tx_chan.sample_every = self.trace_sample_every
         # the result connection is accepted lazily in _recv_tensor: the
         # last node only dials back once its first tensor arrives, so
@@ -1166,7 +1283,8 @@ class ChainDispatcher:
 
     def deploy(self, stages, params, node_addrs: Sequence, *,
                batch: int = 1, result_hop: str | None = None,
-               codecs: Sequence[str] | None = None):
+               codecs: Sequence[str] | None = None,
+               tiers: Sequence[str] | None = None):
         """Ship each stage's artifact to its node(s) over the control
         channel.
 
@@ -1186,6 +1304,12 @@ class ChainDispatcher:
         Adjacent replicated stages are rejected — a replica cannot
         restore another fan-out's order.  ``codecs`` (per stage) sets
         each stage's OUTBOUND hop codec; default: this dispatcher's.
+        ``tiers`` (per stage, ``auto``/``tcp``) sets each stage's
+        OUTBOUND transport-tier policy the same way — the deploy-time
+        half of the tier handshake (docs/TRANSPORT.md): ``auto`` stages
+        offer the colocated fast path when they open their downstream
+        connection and silently degrade to tcp when the peer is another
+        process.
         """
         from ..utils.export import export_stage_bytes
         groups = [[a] if isinstance(a, str) else list(a)
@@ -1206,6 +1330,8 @@ class ChainDispatcher:
             for j, addr in enumerate(addrs):
                 msg = {"cmd": "deploy", "next": nxt,
                        "codec": codecs[i] if codecs else self.codec}
+                if tiers:
+                    msg["tier"] = tiers[i]
                 if i > 0 and len(groups[i - 1]) > 1:
                     msg["fan_in"] = len(groups[i - 1])
                 if len(addrs) > 1:
@@ -1286,10 +1412,29 @@ class ChainDispatcher:
                                           hist="chain.rx_s")
             self._rx_chan.sample_every = self.trace_sample_every
         kind, y = self._rx_chan.get(timeout=self.timeout_s)
-        while kind == K_CTRL and isinstance(y, dict) \
-                and y.get("cmd") in ("trace", "stream_begin"):
-            # the last node cascaded the trace context / stream marker to
-            # the result hop; informational — the dispatcher originated it
+        while kind == K_CTRL and isinstance(y, dict):
+            cmd = y.get("cmd")
+            if cmd == "tier_probe":
+                # the last node offers the colocated fast path on its
+                # result dial-back: granted, results swap to the
+                # in-memory pipe (the socket stays as lifetime anchor)
+                from ..transport.local import answer_probe
+                pipe = answer_probe(self._res_conn, y,
+                                    accept=self.tier_accept)
+                if pipe is not None:
+                    old = self._rx_chan
+                    self._rx_chan = pipe.receiver
+                    self._rx_chan.sample_every = self.trace_sample_every
+                    self._rx_chan.bind_gauge("chain.rx_queue_depth")
+                    old.release_gauge()
+                    self.tier_in = "local"
+                else:
+                    self.tier_in = "tcp"
+            elif cmd not in ("trace", "stream_begin"):
+                break  # not ours to skip: the kind check below reports
+            # trace / stream_begin: the last node cascaded the trace
+            # context / stream marker to the result hop; informational —
+            # the dispatcher originated it
             kind, y = self._rx_chan.get(timeout=self.timeout_s)
         if kind == K_TENSOR_SEQ:
             # waterfall sampling stamps every frame end to end; the
@@ -1323,6 +1468,13 @@ class ChainDispatcher:
                         merge.end()
                         return
                     if kind == K_CTRL:
+                        if isinstance(value, dict) \
+                                and value.get("cmd") == "tier_probe":
+                            # replica dial-backs never win the fast path
+                            # (the seq merge is wire-framed); refuse so
+                            # the prober degrades instead of hanging
+                            from ..transport.local import answer_probe
+                            answer_probe(c, value, accept=False)
                         continue  # trace / stream_begin: informational
                     if kind != K_TENSOR_SEQ:
                         raise ConnectionError(
@@ -1459,10 +1611,18 @@ class ChainDispatcher:
                         # END cascades through
                         while True:
                             if self._rx_chan is not None:
-                                kind, _ = self._rx_chan.get(
+                                kind, v = self._rx_chan.get(
                                     timeout=self.timeout_s)
                             else:
-                                kind, _ = recv_frame(self._res_conn)
+                                kind, v = recv_frame(self._res_conn)
+                            if kind == K_CTRL and isinstance(v, dict) \
+                                    and v.get("cmd") == "tier_probe":
+                                # zero-result stream: the last node's
+                                # offer arrives during teardown — refuse
+                                # so its END cascades over plain tcp
+                                from ..transport.local import answer_probe
+                                answer_probe(self._res_conn, v,
+                                             accept=False)
                             if kind == K_END:
                                 break
         except (OSError, ConnectionError, ValueError, TimeoutError):
@@ -1554,6 +1714,30 @@ def _normalize_replicas(replicas, n: int) -> list[int]:
     return r_of
 
 
+def _normalize_hop_tiers(hop_tiers, n: int, r_of: list[int],
+                         default: str) -> list[str]:
+    """Per-inter-stage-hop tier list, validated: known names, one entry
+    per hop, and no colocated (local/device) hop touching a replicated
+    stage — the ordered fan machinery is wire-framed by design, so a
+    silent tcp downgrade there would belie the caller's topology."""
+    if hop_tiers is None:
+        return [default] * max(0, n - 1)
+    tiers = [str(t) for t in hop_tiers]
+    if len(tiers) != n - 1:
+        raise ValueError(f"hop_tiers must have one entry per inter-stage "
+                         f"hop ({n - 1}), got {len(tiers)}")
+    for k, t in enumerate(tiers):
+        if t not in ("tcp", "auto", "local", "device"):
+            raise ValueError(f"hop_tiers[{k}] = {t!r}; "
+                             f"use tcp|auto|local|device")
+        if t in ("local", "device") and (r_of[k] > 1 or r_of[k + 1] > 1):
+            raise ValueError(
+                f"hop_tiers[{k}] = {t!r} but stage {k} or {k + 1} is "
+                f"replicated; fan paths ride tcp (drop the replicas or "
+                f"the colocation)")
+    return tiers
+
+
 def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               *, batch: int = 1, codec: str = "raw",
               artifact_dir: str | None = None,
@@ -1563,6 +1747,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               inflight: int | None = None,
               replicas: dict[int, int] | None = None,
               hop_codecs: Sequence[str] | None = None,
+              hop_tiers: Sequence[str] | None = None,
+              tier: str = "auto",
               stats_out: list | None = None,
               spawn_retries: int = 3,
               on_spawn=None,
@@ -1589,7 +1775,31 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     ``hop_codecs`` (len = num stages) sets each stage's OUTBOUND hop
     codec individually (default: ``codec`` everywhere); the dispatcher ->
     stage-0 hop always uses ``codec``.  ``stats_out`` (a list) receives
-    every node's ``stats`` reply — per replica, queried before teardown.
+    every node's ``stats`` reply — per replica, queried before teardown
+    (each row carries the hop's negotiated transport ``tier``).
+
+    Transport tiers (docs/TRANSPORT.md): ``hop_tiers`` (len = num
+    stages - 1, one entry per INTER-stage hop) classifies each boundary:
+
+    * ``"device"`` — the two stages land on one device: they are FUSED
+      into a single jit-compiled stage program before spawn
+      (``partition.fuse_stages``), so the hop — frame, queue, process —
+      ceases to exist.
+    * ``"local"`` — same process: the two stages are COLOCATED into one
+      OS process (the downstream rides the upstream's process as a
+      ``--co-stage`` serve thread) and the hop negotiates the
+      zero-serialization in-memory channel.  A handshake that fails
+      anyway degrades to tcp and bumps ``transport.tier_fallback``.
+    * ``"auto"`` — separate processes; the hop still offers the fast
+      path at connect time (it will degrade to tcp cross-process).
+    * ``"tcp"`` — the status-quo wire path, no probe.
+
+    Neither side of a ``device``/``local`` hop may be replicated (the
+    ordered fan machinery is wire-framed by design).  ``tier`` is the
+    policy for the dispatcher-edge hops (dispatcher -> stage 0, last
+    stage -> result server) and the default when ``hop_tiers`` is
+    omitted: ``"auto"`` (offers that degrade cleanly) or ``"tcp"`` (the
+    escape hatch — a pure wire chain end to end).
 
     Children that exit with an address-in-use bind failure (the
     ``_free_ports`` probe race) are detected and the whole spawn retries
@@ -1639,6 +1849,39 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                 f"({n}), got {len(hop_codecs)}")
         codec_of = list(hop_codecs) if hop_codecs is not None \
             else [codec] * n
+        if tier not in ("tcp", "auto"):
+            raise ValueError(f"tier must be tcp|auto, got {tier!r}")
+        tiers = _normalize_hop_tiers(hop_tiers, n, r_of, tier)
+        if not overlap and any(t == "local" for t in tiers):
+            # the serial baseline loop is pure-wire by design and always
+            # refuses tier offers — an EXPLICIT local claim would
+            # silently run full codec + TCP inside one process, so
+            # reject loudly (same rule as replicated colocated hops);
+            # "auto" offers still degrade cleanly under --no-overlap
+            raise ValueError(
+                "hop_tiers 'local' requires the overlapped node loop "
+                "(drop overlap=False / --no-overlap)")
+        if any(t == "device" for t in tiers):
+            # fuse every device-tier hop: adjacent stages become ONE
+            # jit-compiled stage program and the hop ceases to exist
+            from ..partition.partitioner import fuse_stages
+            stages, groups = fuse_stages(list(stages), tiers)
+            r_of = [r_of[g[0]] for g in groups]
+            codec_of = [codec_of[g[-1]] for g in groups]
+            tiers = [tiers[g[-1]] for g in groups[:-1]]
+            n = len(stages)
+        # colocation groups: maximal runs of stages joined by "local"
+        # hops share one OS process (co-stage serve threads)
+        coloc = [[0]]
+        for k in range(n - 1):
+            if tiers[k] == "local":
+                coloc[-1].append(k + 1)
+            else:
+                coloc.append([k + 1])
+        #: per-stage OUTBOUND tier policy argv ("local" claims ride the
+        #: same auto probe — colocation is what makes them succeed)
+        tier_of = [("auto" if tiers[k] in ("auto", "local") else "tcp")
+                   for k in range(n - 1)] + [tier]
 
         child_env = dict(os.environ)
         if env is None:
@@ -1668,7 +1911,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                     on_spawn=on_spawn,
                     trace_sample_every=trace_sample_every,
                     plan=plan, graph=graph,
-                    report_interval_ms=report_interval_ms)
+                    report_interval_ms=report_interval_ms,
+                    coloc=coloc, tier_of=tier_of, tier=tier)
             except _BindRace as e:
                 last_exc = e
                 print(f"run_chain: bind race on attempt {attempt + 1} "
@@ -1688,7 +1932,7 @@ class _BindRace(RuntimeError):
 
 
 def _await_binds(procs, labels, logs, flat_addrs, *,
-                 timeout_s: float = 90.0) -> None:
+                 timeout_s: float = 90.0, proc_of=None) -> None:
     """Block until every child REPORTS its bind (the ``listening on``
     line ``cmd_node`` prints right after ``StageNode`` binds), or
     diagnose the one that died trying: a bind-race death raises
@@ -1696,13 +1940,23 @@ def _await_binds(procs, labels, logs, flat_addrs, *,
     carrying that node's log tail.  This is what turns the old bare
     180 s connect timeout into a fast, attributed failure.  The log line
     (not a connect probe) is the signal on purpose: a stolen port still
-    ACCEPTS connections — from whoever stole it."""
+    ACCEPTS connections — from whoever stole it.
+
+    ``proc_of`` maps each ``flat_addrs`` index to its process index
+    (default: identity) — a COLOCATED process hosts several stage
+    listeners, each printing its own ``listening on <addr>`` line, so
+    the wait is per-address, matched on the address itself."""
     deadline = time.monotonic() + timeout_s
     for i, addr in enumerate(flat_addrs):
+        p = i if proc_of is None else proc_of[i]
         while True:
-            rc = procs[i].poll()
-            tail = _log_tail(logs[i], limit=4000)
-            if "listening on" in tail:
+            rc = procs[p].poll()
+            tail = _log_tail(logs[p], limit=8000)
+            # delimited match: cmd_node always prints "... listening on
+            # <addr>, next ..." — a bare prefix match would accept port
+            # 50001's line while waiting on port 5000
+            if f"listening on {addr}," in tail or (
+                    proc_of is None and "listening on" in tail):
                 break
             if rc is not None and rc != 0:
                 if any(m in tail for m in _BIND_RACE_MARKS):
@@ -1722,56 +1976,99 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                    r_of, paths, in_band, tuning, child_env, artifact_dir,
                    rx_depth, tx_depth, stats_out, on_spawn,
                    trace_sample_every=0, plan=None, graph=None,
-                   report_interval_ms=250.0):
+                   report_interval_ms=250.0, coloc=None, tier_of=None,
+                   tier="tcp"):
     """One spawn -> deploy -> stream -> teardown attempt (see
     ``run_chain``).  Raises :class:`_BindRace` when a child died with an
     address-in-use failure; any other failure surfaces the dead node's
-    log tail after every remaining child has been terminated."""
+    log tail after every remaining child has been terminated.
+
+    ``coloc`` groups stage indices into OS processes (stages joined by
+    ``local``-tier hops ride one process: the first member is the
+    process's primary node, the rest board as ``--co-stage`` serve
+    threads); ``tier_of`` is each stage's outbound tier-policy argv."""
     n = len(stages)
+    if coloc is None:
+        coloc = [[k] for k in range(n)]
+    if tier_of is None:
+        tier_of = [tier] * n
     total = sum(r_of)
     ports = _free_ports(total + 1)  # per-replica listen ports + result
     result_port = ports[-1]
     # stage k's replica ports, in spawn order
     addrs: list[list[str]] = []
-    labels: list[str] = []   # flat per-process labels for diagnostics
     p = 0
     for k in range(n):
         addrs.append([f"127.0.0.1:{ports[p + j]}" for j in range(r_of[k])])
-        labels += ([f"stage{k}" if r_of[k] == 1 else f"stage{k}.r{j}"
-                    for j in range(r_of[k])])
         p += r_of[k]
 
-    def argv_for(k: int, j: int) -> list[str]:
+    def stage_label(k: int, j: int) -> str:
+        return f"stage{k}" if r_of[k] == 1 else f"stage{k}.r{j}"
+
+    def next_of(k: int) -> str:
+        return ",".join(addrs[k + 1]) if k + 1 < n \
+            else f"127.0.0.1:{result_port}"
+
+    def flags_for(k: int, j: int) -> list[str]:
+        if in_band:
+            return []
+        flags = ["--artifact", paths[k], "--next", next_of(k),
+                 "--codec", codec_of[k], "--tier", tier_of[k]]
+        if k > 0 and r_of[k - 1] > 1:
+            flags += ["--fan-in", str(r_of[k - 1])]
+        if r_of[k] > 1:
+            flags += ["--replica", str(j)]
+        return flags
+
+    #: spawn units: one OS process each, hosting >= 1 (stage, replica)
+    #: members (colocation groups always have replica counts of 1)
+    units: list[list[tuple[int, int]]] = []
+    for grp in coloc:
+        if len(grp) == 1:
+            units += [[(grp[0], j)] for j in range(r_of[grp[0]])]
+        else:
+            units.append([(k, 0) for k in grp])
+
+    def argv_for(unit) -> list[str]:
+        k0, j0 = unit[0]
         argv = [sys.executable, "-m", "defer_tpu", "node",
-                "--listen", addrs[k][j]]
-        if not in_band:
-            nxt = ",".join(addrs[k + 1]) if k + 1 < n \
-                else f"127.0.0.1:{result_port}"
-            argv += ["--artifact", paths[k], "--next", nxt,
-                     "--codec", codec_of[k]]
-            if k > 0 and r_of[k - 1] > 1:
-                argv += ["--fan-in", str(r_of[k - 1])]
-            if r_of[k] > 1:
-                argv += ["--replica", str(j)]
+                "--listen", addrs[k0][j0]] + flags_for(k0, j0)
+        for k, j in unit[1:]:
+            # accept=1 always: every co-stage's INBOUND hop is the
+            # local-tier boundary that put it in this process, whatever
+            # its own outbound policy says
+            spec = f"listen={addrs[k][j]};accept=1"
+            if not in_band:
+                spec += (f";artifact={paths[k]};next={next_of(k)}"
+                         f";codec={codec_of[k]};tier={tier_of[k]}")
+            argv += ["--co-stage", spec]
         return argv + tuning
 
     procs, logs = [], []
+    labels: list[str] = []   # per-process labels for diagnostics
     failure: BaseException | None = None
     try:
-        for k in range(n):
-            for j in range(r_of[k]):
-                # log to files, not PIPEs: an undrained pipe fills and
-                # deadlocks a chatty child mid-chain
-                name = f"node_{k}" + (f"_r{j}" if r_of[k] > 1 else "")
-                lf = open(os.path.join(artifact_dir, f"{name}.log"), "w+")
-                logs.append(lf)
-                procs.append(subprocess.Popen(
-                    argv_for(k, j), env=child_env, stdout=lf,
-                    stderr=subprocess.STDOUT))
+        for unit in units:
+            # log to files, not PIPEs: an undrained pipe fills and
+            # deadlocks a chatty child mid-chain
+            name = "node_" + "+".join(
+                f"{k}" + (f"_r{j}" if r_of[k] > 1 else "")
+                for k, j in unit)
+            labels.append("+".join(stage_label(k, j) for k, j in unit))
+            lf = open(os.path.join(artifact_dir, f"{name}.log"), "w+")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                argv_for(unit), env=child_env, stdout=lf,
+                stderr=subprocess.STDOUT))
         if on_spawn is not None:
             on_spawn(procs)
-        flat = [a for group in addrs for a in group]
-        _await_binds(procs, labels, logs, flat)
+        flat, flat_labels, proc_of = [], [], []
+        for u, unit in enumerate(units):
+            for k, j in unit:
+                flat.append(addrs[k][j])
+                flat_labels.append(stage_label(k, j))
+                proc_of.append(u)
+        _await_binds(procs, flat_labels, logs, flat, proc_of=proc_of)
 
         try:
             disp = ChainDispatcher(",".join(addrs[0]),
@@ -1783,7 +2080,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                                    tx_depth=tx_depth if tx_depth else 8,
                                    rx_depth=rx_depth if rx_depth else 8,
                                    result_fan_in=r_of[-1],
-                                   trace_sample_every=trace_sample_every)
+                                   trace_sample_every=trace_sample_every,
+                                   tier=tier)
         except OSError as e:
             import errno
             if getattr(e, "errno", None) == errno.EADDRINUSE \
@@ -1799,7 +2097,7 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
         try:
             if in_band:
                 disp.deploy(stages, params, addrs, batch=batch,
-                            codecs=codec_of)
+                            codecs=codec_of, tiers=tier_of)
             if tracer().enabled:
                 # one coherent cross-process timeline: correct every
                 # node's wall anchor before any stream spans record

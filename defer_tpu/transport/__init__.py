@@ -2,4 +2,6 @@ from .channel import AsyncReceiver, AsyncSender, ChannelError
 from .framed import (K_BYTES, K_END, K_TENSOR, K_TENSOR_SEQ, TensorClient,
                      TensorServer, configure_socket, recv_frame, send_end,
                      send_frame)
+from .local import (LocalPipe, LocalReceiver, LocalSender, grant_local,
+                    offer_local)
 from .replicate import FanInMerge, FanOutSender

@@ -70,8 +70,14 @@ def configure_socket(sock: socket.socket, *, nodelay: bool = True,
     small K_CTRL/K_ACK/K_END frames under Nagle + delayed ACK add up to
     ~40 ms stalls per handshake on localhost chains, so NODELAY is the
     default on every data socket.  Non-TCP sockets (AF_UNIX socketpairs
-    in tests) are left untouched.
+    in tests) are left untouched, and non-socket transports entirely —
+    the in-memory channel objects of the ``local`` tier
+    (``transport/local.py``) have no kernel buffers to size, so every
+    tuning step (NODELAY, SO_SNDBUF/SO_RCVBUF, the ``default_sock_buf``
+    clamp) is skipped rather than raising on them.
     """
+    if not isinstance(sock, socket.socket):
+        return sock  # non-TCP tier (local pipe end / test double)
     if sndbuf is None:
         sndbuf = SOCK_SNDBUF
     if rcvbuf is None:

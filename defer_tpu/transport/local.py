@@ -1,0 +1,363 @@
+"""Colocated transport tier: zero-serialization in-process channels.
+
+Every hop of the multi-process chain pays a TCP round trip plus a host
+encode/decode — even when both endpoints live in the SAME process (the
+in-process thread chains tests and smokes run, or stages deliberately
+colocated on one host to share a device).  GSPMD's rule for co-scheduled
+programs (PAPERS.md) is that a boundary transfer between colocated
+stages should never touch the host wire path; this module is that rule
+for the chain transport:
+
+* :class:`LocalPipe` — a bounded in-memory frame stream.  Tensor frames
+  carry the live ``ndarray``/``jax.Array`` BY REFERENCE: no codec, no
+  framing, no socket, zero copies.  The queue is bounded, so the
+  backpressure contract of the TCP path survives verbatim: a slow
+  consumer parks the producer after ``depth`` frames, exactly like a
+  full ``AsyncSender`` queue.  Sequence stamping (``send(arr, seq=)``)
+  is preserved so replication fan-in bookkeeping and waterfall seqs keep
+  working if a colocated hop ever sits on a stamped path.
+* **Negotiation** — the sender dials TCP as always, then offers the fast
+  path with a ``tier_probe`` control frame carrying its pid, protocol
+  version, and a token it registered in this process's pipe registry.
+  The receiver grants only when the pid matches, the protocol version
+  matches, AND the token resolves in ITS registry — the registry lookup
+  is the proof of same-process-ness (a remote peer's token can never
+  resolve here).  Any failed check silently degrades the hop to plain
+  TCP and bumps the ``transport.tier_fallback`` counter; the stream is
+  byte-identical either way.
+
+The third tier, ``device``, has no transport object at all: adjacent
+stages that land on one device are FUSED into a single jit-compiled
+stage program at deploy time (``partition.fuse_stages``), so the hop —
+frame, queue, and everything — ceases to exist (the MPK
+mega-kernelization direction, PAPERS.md).
+
+Channel-surface compatibility: :class:`LocalSender` mimics
+:class:`~defer_tpu.transport.channel.AsyncSender` (``send`` /
+``send_ctrl`` / ``send_end`` / ``close`` / ``flush`` / ``enc`` /
+watermarks) and :class:`LocalReceiver` mimics
+:class:`~defer_tpu.transport.channel.AsyncReceiver` (``get`` /
+``get_nowait`` / ``bind_gauge`` / ``bind_hist`` / ``release_gauge`` /
+``dec``), so ``StageNode`` / ``ChainDispatcher`` swap them in without
+caring which tier won.  The per-channel ``enc``/``dec`` histograms stay
+EMPTY by design — a colocated hop does no codec work, and the obs plane
+reading zero codec cost for it is the correct reading.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import uuid
+
+from ..obs import REGISTRY, LatencyHistogram
+from .channel import ChannelError
+from .framed import (K_CTRL, K_END, K_TENSOR, K_TENSOR_SEQ,
+                     PROTOCOL_VERSION, recv_expect, send_ctrl)
+
+__all__ = ["LocalPipe", "LocalReceiver", "LocalSender", "grant_local",
+           "offer_local"]
+
+#: hops that wanted a colocated tier but degraded to tcp (failed
+#: handshake: wrong pid, version mismatch, unknown token, refused peer)
+_FALLBACK = REGISTRY.counter("transport.tier_fallback")
+#: tensor frames handed through local pipes (the colocated analogue of
+#: ``transport.tx_frames`` — which local hops must NOT touch, so frame
+#: counters keep meaning "bytes that crossed a wire")
+_LOCAL_FRAMES = REGISTRY.counter("transport.local_frames")
+
+#: token -> LocalPipe: offers awaiting a grant.  Process-local on
+#: purpose — a probe from another process can never resolve its token
+#: here, which is exactly the colocation proof the handshake needs.
+_OFFERS: dict[str, "LocalPipe"] = {}
+_OFFERS_LOCK = threading.Lock()
+
+
+class LocalPipe:
+    """One bounded in-memory frame stream (sender end + receiver end).
+
+    Items are ``(kind, value)`` tuples shaped exactly like
+    ``recv_frame``'s returns — ``(K_TENSOR, arr)``,
+    ``(K_TENSOR_SEQ, (seq, arr))``, ``(K_CTRL, dict)``, ``(K_END,
+    None)`` — so consumers cannot tell (and must not care) whether a
+    frame came off a socket or a pipe.
+    """
+
+    def __init__(self, depth: int = 8):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        #: sender enqueued its END (clean shutdown)
+        self._ended = False
+        #: sender abandoned the stream without an END (peer death — the
+        #: pipe analogue of a cut TCP connection)
+        self._sender_gone = False
+        #: receiver will never consume again (its stream loop exited)
+        self._receiver_gone = False
+        #: shared gauge published by the receiver's bind_gauge, plus
+        #: enqueue/dequeue counts maintained under ``_glock`` — gauge
+        #: accounting goes through the COUNTS, never ``qsize()``, so a
+        #: bind racing an in-flight put can't double-count an item (the
+        #: producer reports it after the bind sees it absent, or the
+        #: bind's backlog sweep covers it; never both)
+        self._gauge = None
+        self._glock = threading.Lock()
+        self._enq = 0
+        self._deq = 0
+        self.sender = LocalSender(self)
+        self.receiver = LocalReceiver(self)
+
+
+class LocalSender:
+    """Producer end of a :class:`LocalPipe` (AsyncSender surface)."""
+
+    #: waterfall sampling period — accepted for surface parity; local
+    #: hops record no per-frame tx/rx spans (there is no tx/rx phase)
+    sample_every: int = 0
+    codec = "local"   #: nominal; no codec ever runs on a local hop
+
+    def __init__(self, pipe: LocalPipe):
+        self._pipe = pipe
+        self._q = pipe._q
+        self.depth = pipe.depth
+        #: per-channel encode histogram — stays empty (zero codec work)
+        self.enc = LatencyHistogram()
+        self.hi = 0
+        self.err: BaseException | None = None
+
+    # -- producer side ------------------------------------------------------
+
+    def send(self, arr, *, seq: int | None = None) -> None:
+        if seq is None:
+            self._put((K_TENSOR, arr))
+        else:
+            self._put((K_TENSOR_SEQ, (seq, arr)))
+        _LOCAL_FRAMES.n += 1
+
+    def send_ctrl(self, msg: dict) -> None:
+        self._put((K_CTRL, dict(msg)))
+
+    def send_end(self) -> None:
+        self._put((K_END, None))
+        self._pipe._ended = True
+
+    def close(self, timeout: float | None = None) -> None:
+        """END the stream.  Once enqueued the frame IS delivered — the
+        consumer holds the same queue — so unlike ``AsyncSender.close``
+        there is no tx thread to join; ``timeout`` bounds only the wait
+        for a queue slot against a stalled (alive but not consuming)
+        peer, keeping the dead-chain-fails-not-hangs contract."""
+        self._put((K_END, None), timeout=timeout)
+        self._pipe._ended = True
+
+    def flush(self, timeout: float | None = None) -> None:
+        """No-op: ``send`` hands the frame to the consumer synchronously
+        (there is no encode/wire stage to drain)."""
+        if self.err is not None:
+            raise ChannelError("local channel peer gone") from self.err
+
+    def detach(self) -> None:
+        """Abandon the stream: called by the owner's teardown path.  A
+        detach WITHOUT a prior END marks the sender dead so a consumer
+        parked in ``get`` fails like it would on a cut TCP connection
+        (after the clean END this is a no-op)."""
+        if not self._pipe._ended:
+            self._pipe._sender_gone = True
+
+    def _put(self, item, timeout: float | None = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._pipe._receiver_gone:
+                self.err = ConnectionError(
+                    "local channel receiver abandoned the stream")
+                raise ChannelError("local channel receiver gone") \
+                    from self.err
+            try:
+                self._q.put(item, timeout=0.05)
+            except queue.Full:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"local channel full for {timeout:.1f}s "
+                        f"(peer stopped consuming)")
+                continue  # bounded queue: backpressure, like a full
+                #   AsyncSender queue / a stalled TCP window
+            with self._pipe._glock:
+                self._pipe._enq += 1
+                if self._pipe._gauge is not None:
+                    self._pipe._gauge.inc()
+            q = self._q.qsize()
+            if q > self.hi:
+                self.hi = q
+            return
+
+    def take_watermark(self) -> int:
+        h = max(self.hi, self._q.qsize())
+        self.hi = self._q.qsize()
+        return h
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+class LocalReceiver:
+    """Consumer end of a :class:`LocalPipe` (AsyncReceiver surface)."""
+
+    sample_every: int = 0
+
+    def __init__(self, pipe: LocalPipe):
+        self._pipe = pipe
+        self._q = pipe._q
+        self.depth = pipe.depth
+        #: per-channel decode histogram — stays empty (zero codec work)
+        self.dec = LatencyHistogram()
+        self.hi = 0
+        self.err: BaseException | None = None
+
+    def bind_gauge(self, name: str) -> None:
+        g = REGISTRY.gauge(name)
+        with self._pipe._glock:
+            # backlog = enqueues whose producers already reported under
+            # the lock; an in-flight put not yet counted here will see
+            # the gauge and report itself — each item counted once
+            g.inc(self._pipe._enq - self._pipe._deq)
+            self._pipe._gauge = g
+
+    def bind_hist(self, name: str) -> None:
+        """Accepted for surface parity; a local hop has no recv+decode
+        phase to time, so nothing is ever recorded under ``name``."""
+
+    def release_gauge(self) -> None:
+        """Reconcile the shared additive gauge AND mark this end gone so
+        a producer parked in ``send`` wakes with :class:`ChannelError`
+        instead of blocking forever against a dead stream."""
+        self._pipe._receiver_gone = True
+        with self._pipe._glock:
+            g, self._pipe._gauge = self._pipe._gauge, None
+            if g is not None:
+                g.dec(self._pipe._enq - self._pipe._deq)
+
+    def get(self, timeout: float | None = None) -> tuple:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._pipe._sender_gone and self._q.empty():
+                    self.err = ConnectionError(
+                        "local channel peer closed mid-stream")
+                    raise self.err
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"no frame within {timeout:.1f}s")
+                continue
+            return self._got(item)
+
+    def get_nowait(self) -> tuple:
+        try:
+            item = self._q.get_nowait()
+        except queue.Empty:
+            if self._pipe._sender_gone:
+                self.err = ConnectionError(
+                    "local channel peer closed mid-stream")
+                raise self.err from None
+            raise
+        return self._got(item)
+
+    def _got(self, item) -> tuple:
+        with self._pipe._glock:
+            self._pipe._deq += 1
+            if self._pipe._gauge is not None:
+                self._pipe._gauge.dec()
+        q = self._q.qsize()
+        if q > self.hi:
+            self.hi = q
+        return item
+
+    def take_watermark(self) -> int:
+        h = max(self.hi, self._q.qsize())
+        self.hi = self._q.qsize()
+        return h
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+# ---------------------------------------------------------------------------
+# negotiation
+# ---------------------------------------------------------------------------
+
+def _register(pipe: LocalPipe) -> str:
+    token = uuid.uuid4().hex
+    with _OFFERS_LOCK:
+        _OFFERS[token] = pipe
+    return token
+
+
+def _claim(token) -> LocalPipe | None:
+    with _OFFERS_LOCK:
+        return _OFFERS.pop(token, None)
+
+
+def offer_local(sock, *, depth: int = 8) -> tuple[str, LocalPipe | None]:
+    """Offer the colocated fast path on a freshly dialed data socket.
+
+    Sends the ``tier_probe`` control frame and synchronously awaits the
+    peer's ``tier_reply`` (the probe is the FIRST frame on the
+    connection, so the reply cannot interleave with data).  Returns
+    ``("local", pipe)`` when granted — the caller sends all further
+    frames through ``pipe.sender`` and keeps the socket only as the
+    connection's lifetime anchor — or ``("tcp", None)`` after a refusal,
+    bumping ``transport.tier_fallback``: the hop silently degrades to
+    the status-quo wire path on the same socket.
+    """
+    pipe = LocalPipe(depth=depth)
+    token = _register(pipe)
+    try:
+        send_ctrl(sock, {"cmd": "tier_probe", "want": "local",
+                         "pid": os.getpid(), "proto": PROTOCOL_VERSION,
+                         "token": token})
+        reply = recv_expect(sock, K_CTRL)
+    finally:
+        _claim(token)  # granted probes were already claimed by the peer
+    if isinstance(reply, dict) and reply.get("cmd") == "tier_reply" \
+            and reply.get("tier") == "local":
+        return "local", pipe
+    _FALLBACK.n += 1
+    return "tcp", None
+
+
+def grant_local(msg) -> LocalPipe | None:
+    """Validate one ``tier_probe`` control frame; return the offered
+    pipe when the colocation claim holds, else None (caller replies
+    ``tier_reply: tcp`` and the hop degrades).
+
+    Checks, in order: the probe wants ``local``; the wire protocol
+    version matches (a future v3 sender must not splice a v2 pipe); the
+    peer's pid is THIS process's pid; and the token resolves in this
+    process's offer registry — the structural proof both ends share one
+    address space (a remote process's token can never resolve here, so
+    a forged pid alone is never enough)."""
+    if not isinstance(msg, dict) or msg.get("want") != "local":
+        return None
+    try:
+        if int(msg.get("proto", -1)) != PROTOCOL_VERSION:
+            return None
+        if int(msg.get("pid", -1)) != os.getpid():
+            return None
+    except (TypeError, ValueError):
+        return None
+    return _claim(msg.get("token"))
+
+
+def answer_probe(conn, msg, *, accept: bool = True):
+    """Receiver-side handshake: validate ``msg`` (when ``accept``),
+    send the ``tier_reply`` on ``conn``, and return the granted
+    :class:`LocalPipe` or None.  The one helper every serve loop uses so
+    a probe is ALWAYS answered — an unanswered probe would park the
+    offering peer in its reply wait."""
+    pipe = grant_local(msg) if accept else None
+    send_ctrl(conn, {"cmd": "tier_reply",
+                     "tier": "local" if pipe is not None else "tcp"})
+    return pipe

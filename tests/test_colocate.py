@@ -1,0 +1,415 @@
+"""Colocated transport tier (docs/TRANSPORT.md): local-pipe semantics,
+tier negotiation + fallback, fused device-tier stages, and the planner's
+hop-tier map — the in-process halves of ``scripts/colocate_smoke.py``.
+"""
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import partition
+from defer_tpu.models import resnet_tiny
+from defer_tpu.obs import REGISTRY
+from defer_tpu.partition import fuse_stages
+from defer_tpu.runtime.node import ChainDispatcher, StageNode
+from defer_tpu.transport.channel import ChannelError
+from defer_tpu.transport.framed import (K_CTRL, K_END, K_TENSOR,
+                                        K_TENSOR_SEQ, PROTOCOL_VERSION,
+                                        configure_socket, recv_frame,
+                                        send_ctrl)
+from defer_tpu.transport.local import (LocalPipe, grant_local, offer_local)
+
+
+def _counter(name: str) -> float:
+    return REGISTRY.counter(name).value
+
+
+def _hist_count(name: str) -> int:
+    return int(REGISTRY.histogram(name).summary().get("count", 0))
+
+
+# ---------------------------------------------------------------------------
+# LocalPipe semantics
+# ---------------------------------------------------------------------------
+
+def test_pipe_roundtrip_order_seq_ctrl_end():
+    p = LocalPipe(depth=8)
+    a = np.arange(6, dtype=np.float32)
+    p.sender.send_ctrl({"cmd": "trace", "trace_id": "t"})
+    p.sender.send(a)
+    p.sender.send(a * 2, seq=7)
+    p.sender.send_end()
+    assert p.receiver.get(1.0) == (K_CTRL, {"cmd": "trace",
+                                            "trace_id": "t"})
+    kind, v = p.receiver.get(1.0)
+    assert kind == K_TENSOR and v is a  # BY REFERENCE: zero copies
+    kind, (seq, v) = p.receiver.get(1.0)
+    assert kind == K_TENSOR_SEQ and seq == 7
+    np.testing.assert_array_equal(v, a * 2)
+    assert p.receiver.get(1.0) == (K_END, None)
+
+
+def test_pipe_backpressure_is_bounded():
+    """A slow consumer parks the producer after ``depth`` frames — the
+    TCP backpressure contract, verbatim."""
+    p = LocalPipe(depth=2)
+    sent = []
+
+    def produce():
+        for i in range(6):
+            p.sender.send(i)
+            sent.append(i)
+        p.sender.send_end()
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    t.join(timeout=0.5)
+    assert t.is_alive() and len(sent) <= 3  # parked on the full queue
+    got = []
+    while True:
+        kind, v = p.receiver.get(2.0)
+        if kind == K_END:
+            break
+        got.append(v)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert got == list(range(6))  # in order, nothing dropped
+
+
+def test_pipe_sender_death_fails_receiver():
+    p = LocalPipe()
+    p.sender.send(1)
+    p.sender.detach()  # abandoned WITHOUT an END: a cut connection
+    assert p.receiver.get(1.0) == (K_TENSOR, 1)
+    with pytest.raises(ConnectionError):
+        p.receiver.get(1.0)
+    with pytest.raises(ConnectionError):
+        p.receiver.get_nowait()
+
+
+def test_pipe_clean_end_then_detach_is_noop():
+    p = LocalPipe()
+    p.sender.close()
+    p.sender.detach()
+    assert p.receiver.get(1.0) == (K_END, None)
+
+
+def test_pipe_receiver_death_wakes_parked_sender():
+    p = LocalPipe(depth=1)
+    p.sender.send(0)
+    err = []
+
+    def produce():
+        try:
+            p.sender.send(1)  # parks on the full queue
+        except ChannelError as e:
+            err.append(e)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    t.join(timeout=0.3)
+    assert t.is_alive()
+    p.receiver.release_gauge()  # the consumer's stream loop exited
+    t.join(timeout=5.0)
+    assert not t.is_alive() and err, "parked producer never woke"
+
+
+def test_pipe_gauge_reconciles():
+    p = LocalPipe(depth=4)
+    p.sender.send(0)
+    p.receiver.bind_gauge("test.colo_gauge")
+    g = REGISTRY.gauge("test.colo_gauge")
+    base = g.value
+    p.sender.send(1)
+    assert g.value == base + 1
+    p.receiver.get(1.0)
+    assert g.value == base
+    p.receiver.release_gauge()  # returns the remaining occupancy
+    assert g.value == base - 1
+
+
+# ---------------------------------------------------------------------------
+# negotiation: grant validation + fallback
+# ---------------------------------------------------------------------------
+
+def _probe_msg(pipe: LocalPipe) -> dict:
+    """A well-formed probe for ``pipe`` (registered in this process)."""
+    from defer_tpu.transport import local as L
+    return {"cmd": "tier_probe", "want": "local", "pid": os.getpid(),
+            "proto": PROTOCOL_VERSION, "token": L._register(pipe)}
+
+
+def test_grant_rejects_distinct_pid():
+    msg = _probe_msg(LocalPipe())
+    msg["pid"] = msg["pid"] + 1
+    assert grant_local(msg) is None
+
+
+def test_grant_rejects_version_mismatch():
+    msg = _probe_msg(LocalPipe())
+    msg["proto"] = PROTOCOL_VERSION + 1
+    assert grant_local(msg) is None
+
+
+def test_grant_rejects_unknown_token():
+    msg = _probe_msg(LocalPipe())
+    msg["token"] = "not-a-registered-token"
+    assert grant_local(msg) is None
+
+
+def test_grant_claims_exactly_once():
+    pipe = LocalPipe()
+    msg = _probe_msg(pipe)
+    assert grant_local(msg) is pipe
+    assert grant_local(msg) is None  # token single-use
+
+
+def test_offer_refused_degrades_and_counts():
+    """The sender side of the satellite contract: a refused offer comes
+    back ("tcp", None) with ``transport.tier_fallback`` bumped — and the
+    socket remains usable for the status-quo wire path."""
+    a, b = socket.socketpair()
+
+    def peer():
+        kind, msg = recv_frame(b)
+        assert kind == K_CTRL and msg["cmd"] == "tier_probe"
+        send_ctrl(b, {"cmd": "tier_reply", "tier": "tcp"})
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    before = _counter("transport.tier_fallback")
+    tier, pipe = offer_local(a)
+    t.join(timeout=5.0)
+    assert (tier, pipe) == ("tcp", None)
+    assert _counter("transport.tier_fallback") == before + 1
+    a.close()
+    b.close()
+
+
+def test_offer_granted_over_socketpair():
+    a, b = socket.socketpair()
+    got = {}
+
+    def peer():
+        kind, msg = recv_frame(b)
+        got["pipe"] = grant_local(msg)
+        send_ctrl(b, {"cmd": "tier_reply", "tier": "local"})
+
+    t = threading.Thread(target=peer, daemon=True)
+    t.start()
+    tier, pipe = offer_local(a)
+    t.join(timeout=5.0)
+    assert tier == "local" and pipe is not None
+    assert got["pipe"] is pipe  # BOTH ends hold the same pipe
+    arr = np.ones(3, np.float32)
+    pipe.sender.send(arr)
+    kind, v = got["pipe"].receiver.get(1.0)
+    assert kind == K_TENSOR and v is arr
+    a.close()
+    b.close()
+
+
+def test_configure_socket_skips_non_sockets():
+    """Satellite: socket tuning must no-op (not raise) on non-TCP tiers'
+    channel objects."""
+    sentinel = object()
+    assert configure_socket(sentinel) is sentinel
+    pipe = LocalPipe()
+    assert configure_socket(pipe.sender) is pipe.sender
+    assert configure_socket(pipe.receiver) is pipe.receiver
+
+
+# ---------------------------------------------------------------------------
+# in-process chains: byte identity across tiers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    g = resnet_tiny()
+    return g, g.init(jax.random.key(0))
+
+
+def _run_chain_inproc(stages, params, xs, *, tier, node_tiers=None,
+                      accepts=None, codecs=None):
+    """Thread-per-node chain; returns (outs, stats)."""
+    n = len(stages)
+    nodes = [StageNode(None, "127.0.0.1:0", None,
+                       tier=(node_tiers or [tier] * n)[i],
+                       tier_accept=True if accepts is None else accepts[i])
+             for i in range(n)]
+    addrs = [f"127.0.0.1:{nd.address[1]}" for nd in nodes]
+    threads = [threading.Thread(target=nd.serve, daemon=True)
+               for nd in nodes]
+    for t in threads:
+        t.start()
+    disp = ChainDispatcher(addrs[0], codec="raw", tier=tier)
+    try:
+        disp.deploy(stages, params, addrs, batch=xs[0].shape[0],
+                    codecs=codecs, tiers=node_tiers or [tier] * n)
+        outs = disp.stream(xs)
+        stats = disp.stats(addrs)
+    finally:
+        disp.close()
+    for t in threads:
+        t.join(timeout=60)
+    return outs, stats
+
+
+@pytest.fixture(scope="module")
+def chain3(tiny):
+    g, params = tiny
+    stages = partition(g, num_stages=3)
+    rng = np.random.default_rng(3)
+    xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+          for _ in range(5)]
+    outs, stats = _run_chain_inproc(stages, params, xs, tier="tcp")
+    return g, params, stages, xs, outs, stats
+
+
+def test_local_chain_byte_identical_no_codec_work(chain3):
+    """All-colocated chain: every hop negotiates local, outputs are
+    byte-identical to the all-TCP chain, and — the satellite regression
+    — ZERO ``codec.*`` histogram samples are recorded on local hops
+    (the raw path previously paid encode+decode even in-process)."""
+    g, params, stages, xs, base, base_stats = chain3
+    assert [s["tier"] for s in base_stats] == ["tcp"] * 3
+    enc0, dec0 = _hist_count("codec.encode_s"), _hist_count("codec.decode_s")
+    lf0 = _counter("transport.local_frames")
+    outs, stats = _run_chain_inproc(stages, params, xs, tier="auto")
+    assert [s["tier"] for s in stats] == ["local"] * 3
+    assert [s["tier_in"] for s in stats] == ["local"] * 3
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert _hist_count("codec.encode_s") == enc0, \
+        "a local hop recorded codec encode samples"
+    assert _hist_count("codec.decode_s") == dec0, \
+        "a local hop recorded codec decode samples"
+    # 4 hops (disp->s0->s1->s2->result) x len(xs) frames rode the pipes
+    assert _counter("transport.local_frames") - lf0 == 4 * len(xs)
+
+
+def test_mixed_tier_chain_byte_identical(chain3):
+    g, params, stages, xs, base, _ = chain3
+    outs, stats = _run_chain_inproc(
+        stages, params, xs, tier="tcp",
+        node_tiers=["auto", "tcp", "auto"])
+    # hop s0->s1 local; s1->s2 stays tcp; s2->result refused by the
+    # tcp-tier dispatcher (tier_accept=False) -> degrades to tcp
+    assert [s["tier"] for s in stats] == ["local", "tcp", "tcp"]
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_claimed_colocation_degrades_to_tcp(chain3):
+    """Satellite: a hop that CLAIMS colocation but fails the handshake
+    (here: the peer refuses) silently degrades to tcp, the stream stays
+    byte-identical, and ``transport.tier_fallback`` increments."""
+    g, params, stages, xs, base, _ = chain3
+    before = _counter("transport.tier_fallback")
+    outs, stats = _run_chain_inproc(stages, params, xs, tier="auto",
+                                    accepts=[True, False, True])
+    assert _counter("transport.tier_fallback") > before
+    by_stage = {s["stage"]: s["tier"] for s in stats}
+    assert by_stage[0] == "tcp"    # its offer to stage 1 was refused
+    assert by_stage[1] == "local"  # stage 2 still granted
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_chain_byte_identical(chain3):
+    """Device-tier hops fuse adjacent stages into ONE jit stage program
+    — the hop (and its frames) ceases to exist, outputs unchanged."""
+    g, params, stages, xs, base, _ = chain3
+    fused, groups = fuse_stages(stages, ["device", "local"])
+    assert groups == [[0, 1], [2]] and len(fused) == 2
+    assert fused[0].node_names == stages[0].node_names + stages[1].node_names
+    outs, stats = _run_chain_inproc(fused, params, xs, tier="auto")
+    assert [s["stage"] for s in stats] == [0, 1]
+    assert sorted(s["processed"] for s in stats) == [len(xs)] * 2
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fuse_stages_validates():
+    g = resnet_tiny()
+    stages = partition(g, num_stages=3)
+    with pytest.raises(ValueError):
+        fuse_stages(stages, ["device"])  # wrong arity
+    same, groups = fuse_stages(stages, ["tcp", "local"])
+    assert len(same) == 3 and groups == [[0], [1], [2]]
+
+
+# ---------------------------------------------------------------------------
+# planner: the hop-tier map
+# ---------------------------------------------------------------------------
+
+def _fat_boundary_model():
+    from defer_tpu import GraphBuilder
+    from defer_tpu.graph import ops
+    from defer_tpu.plan import StageCostModel
+
+    b = GraphBuilder("fatcut")
+    x = b.input((4096,))
+    for i in range(3):
+        x = b.add(ops.Dense(4096), x, name=f"d{i}")
+    x = b.add(ops.Dense(8), x, name="head")
+    g = b.build()
+    costs = {"d0": 1e-3, "d1": 1e-3, "d2": 1e-3, "head": 1e-4}
+    # slow link: every 4096-float boundary costs ~16 ms on the wire —
+    # comm-bound unless the tier map zeroes it
+    return g, StageCostModel(g, gen="v4", link_bw_s=1e6, node_costs=costs)
+
+
+def test_solver_exploits_hop_tier_map():
+    from defer_tpu.plan import plan_from_json, solve
+
+    g, cm = _fat_boundary_model()
+    p_tcp = solve(g, 3, cm)
+    tiers = {c: "local" for c in ("d0", "d1", "d2")}
+    p_loc = solve(g, 3, cm, hop_tiers=tiers)
+    assert p_loc.bottleneck_s < p_tcp.bottleneck_s  # STRICT: comm-bound
+    assert set(p_loc.codecs) == {"local"}
+    assert p_loc.hop_tiers == ["local"] * 2
+    assert p_tcp.hop_tiers == ["tcp"] * 2
+    doc = p_loc.to_json()
+    assert doc["hop_tiers"] == ["local", "local"]
+    assert plan_from_json(doc).hop_tiers == ["local", "local"]
+
+
+def test_device_tier_is_free_local_pays_memory_bw():
+    g, cm = _fat_boundary_model()
+    cm_d = cm.with_hop_tiers({"d1": "device"})
+    cm_l = cm.with_hop_tiers({"d1": "local"})
+    assert cm_d.comm_seconds("d1", "device") == 0.0
+    local_s = cm_l.comm_seconds("d1", "local")
+    assert 0.0 < local_s < cm.best_codec("d1")[1]
+    assert cm_l.best_codec("d1") == ("local", local_s)
+    assert cm.best_codec("d1")[0] != "local"  # untiered: wire argmin
+
+
+def test_replicated_fan_hops_never_tiered():
+    """A colocated tier only holds when neither side fans — the runtime
+    constraint mirrored into the cost model."""
+    g, cm = _fat_boundary_model()
+    cm = cm.with_hop_tiers({"d1": "local"})
+    name, s = cm.best_codec_replicated("d1", 1, 1)
+    assert name == "local"
+    name2, s2 = cm.best_codec_replicated("d1", 2, 1)
+    assert name2 != "local" and s2 > s
+
+
+def test_replan_preserves_hop_tiers():
+    from defer_tpu.plan import replan, solve
+
+    g, cm = _fat_boundary_model()
+    tiers = {c: "local" for c in ("d0", "d1", "d2")}
+    plan = solve(g, 3, cm, hop_tiers=tiers)
+    rp = replan(g, plan, {0: 2e-3, 1: 1e-3, 2: 1e-3},
+                cm.with_hop_tiers(tiers))
+    assert set(rp.new_plan.hop_tiers) == {"local"}
+    assert set(rp.old_plan_corrected.hop_tiers) == {"local"}
