@@ -245,7 +245,7 @@ def run_config(name, *, tiny: bool, chunk: int, stage_lat: bool,
     return result
 
 
-def run_script_row(script_name: str):
+def run_script_row(script_name: str, extra_argv: list | None = None):
     """Delegate a row to a standalone smoke script in a subprocess (its
     CPU-pinned child environment must never touch this process's
     backend).  Returns the script's JSON row (last stdout line)."""
@@ -259,7 +259,8 @@ def run_script_row(script_name: str):
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PALLAS_AXON_POOL_IPS": "",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
-    proc = subprocess.run([sys.executable, script], capture_output=True,
+    proc = subprocess.run([sys.executable, script] + (extra_argv or []),
+                          capture_output=True,
                           text=True, timeout=900, env=env)
     if proc.returncode != 0:
         raise RuntimeError(
@@ -283,12 +284,21 @@ def run_script_row(script_name: str):
 #: codec-delay-bound chain; fused device hops eliminate the inter-stage
 #: frame entirely; rows record the NEGOTIATED tier per hop so BENCH_*
 #: trajectories distinguish TCP-bound from colocated/fused runs)
+#: ... and `serving_frontdoor` (multi-tenant front door over one
+#: deployed chain: >= 3 concurrent tenant streams byte-identical to
+#: solo runs, continuous batching >= 1.5x sequential one-stream-at-a-
+#: time serving on the delay-bound chain, and SLO-aware shedding
+#: holding admitted p99 inside the SLO under a 2x-overload burst of a
+#: deterministic OPEN-LOOP Poisson arrival trace — closed-loop load
+#: hides queueing delay, so the p99 here is measured against arrivals
+#: fixed up front; `--arrival-seed` reseeds the trace)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
     "stage_replication": "replication_smoke.py",
     "obs_overhead": "monitor_smoke.py",
     "colocated_fastpath": "colocate_smoke.py",
+    "serving_frontdoor": "serve_smoke.py",
 }
 
 
@@ -307,6 +317,10 @@ def main():
     ap.add_argument("--weights-dir", default=None,
                     help="directory of trained checkpoints "
                          "(resnet50.pt, vgg19.pt, mobilenet_v2.pt, ...)")
+    ap.add_argument("--arrival-seed", type=int, default=None,
+                    help="reseed the serving row's open-loop arrival "
+                         "trace (deterministic Poisson + 2x burst; "
+                         "defaults to the smoke's built-in seed)")
     args = ap.parse_args()
 
     chunk = args.chunk or (128 if jax.default_backend() == "tpu" else 16)
@@ -314,8 +328,12 @@ def main():
         name = name.strip()
         if name in SCRIPT_ROWS:
             t0 = time.time()
+            extra = []
+            if name == "serving_frontdoor" \
+                    and args.arrival_seed is not None:
+                extra = ["--seed", str(args.arrival_seed)]
             try:
-                r = run_script_row(SCRIPT_ROWS[name])
+                r = run_script_row(SCRIPT_ROWS[name], extra)
             except Exception as e:  # noqa: BLE001 — keep the suite going
                 log(f"{name}: FAILED {type(e).__name__}: {e}")
                 continue

@@ -19,6 +19,11 @@ deployment from one controller:
              node's obs_push telemetry, aggregate per stage/replica,
              highlight the bottleneck, flag stragglers
              (docs/OBSERVABILITY.md)
+  serve      multi-tenant serving front door over one deployed chain:
+             weighted-fair admission, continuous batching, SLO-aware
+             shedding (docs/SERVING.md)
+  serve-client  open-loop load generator (seeded Poisson + bursts)
+             against a serve front door
 """
 
 from __future__ import annotations
@@ -716,6 +721,187 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
     sys.stdout.flush()
 
 
+def _parse_tenant_specs(specs) -> list:
+    """``name=weight[:priority[:deadline_ms]]`` (repeatable) ->
+    TenantConfig list."""
+    from .serve import TenantConfig
+    out = []
+    for spec in specs or []:
+        name, sep, rest = spec.partition("=")
+        if not sep or not name:
+            raise SystemExit(f"--tenant: {spec!r} is not "
+                             f"name=weight[:priority[:deadline_ms]]")
+        parts = rest.split(":")
+        try:
+            out.append(TenantConfig(
+                name=name, weight=float(parts[0] or 1.0),
+                priority=int(parts[1]) if len(parts) > 1 and parts[1]
+                else 0,
+                deadline_ms=float(parts[2])
+                if len(parts) > 2 and parts[2] else None))
+        except ValueError as e:
+            raise SystemExit(f"--tenant {spec!r}: {e}")
+    return out
+
+
+def cmd_serve(args):
+    """The serving front door (docs/SERVING.md): accept many concurrent
+    client streams, admit under per-tenant weighted-fair queuing with
+    SLO-aware shedding, coalesce admitted samples across tenants into
+    dynamic microbatches sized by the planner's latency budget, and
+    multiplex them onto one deployed chain (tensor mode) or a
+    continuous-batching decode engine (--workload decode)."""
+    import threading
+
+    import jax
+
+    from . import partition
+    from .serve import ServeFrontDoor
+    from .serve.frontdoor import ChainBackend
+
+    graph = _get_model(args.model)
+    params = graph.init(jax.random.key(0))
+    tenants = _parse_tenant_specs(args.tenant)
+    _start_prom(args, "serve")
+
+    if args.workload == "decode":
+        from .serve import ContinuousBatchEngine
+        if "lm_head" not in graph.nodes:
+            raise SystemExit(f"{args.model} is not a decoder model; "
+                             "--workload decode needs a gpt* family")
+        width = args.width or 4
+        engine = ContinuousBatchEngine(graph, params,
+                                       num_stages=args.stages,
+                                       width=width)
+        door = ServeFrontDoor(
+            engine=engine, listen=args.listen, tenants=tenants,
+            decode_defaults={"max_new_tokens": args.max_new})
+        cleanup = lambda: None  # noqa: E731
+    else:
+        cuts = args.cuts.split(",") if args.cuts else None
+        stages = partition(graph, cuts, num_stages=args.stages)
+        cut_names = [s.output_name for s in stages[:-1]]
+        width = args.width
+        if args.budget_ms:
+            # dynamic-microbatch width from the planner's cost model:
+            # the largest frame batch whose slowest stage stays inside
+            # the per-stage latency budget
+            from .plan import max_batch_within_budget
+            cm = _cost_model(args, graph)
+            width = max_batch_within_budget(
+                graph, cut_names, cm, args.budget_ms,
+                cap=args.max_width)
+            print(f"serve: width {width} from --budget-ms "
+                  f"{args.budget_ms:g}", file=sys.stderr, flush=True)
+        width = width or 4
+        hop_codecs = [c for c in args.hop_codecs.split(",") if c] or None
+        if args.nodes:
+            from .runtime.node import ChainDispatcher
+            addrs = [a for a in args.nodes.split(",") if a]
+            if len(addrs) != len(stages):
+                raise SystemExit(f"{len(stages)} stages but "
+                                 f"{len(addrs)} --nodes")
+            disp = ChainDispatcher(addrs[0], codec=args.codec)
+            disp.deploy(stages, params, addrs, batch=width,
+                        codecs=hop_codecs)
+            cleanup = lambda: None  # noqa: E731 — nodes are external
+        else:
+            # self-contained deployment: thread-per-stage nodes in this
+            # process (run `defer_tpu node` per host + --nodes for a
+            # real multi-process chain)
+            from .runtime.node import ChainDispatcher, StageNode
+            nodes = [StageNode(None, "127.0.0.1:0", None)
+                     for _ in stages]
+            addrs = [f"127.0.0.1:{n.address[1]}" for n in nodes]
+            threads = [threading.Thread(target=n.serve, daemon=True)
+                       for n in nodes]
+            for t in threads:
+                t.start()
+            disp = ChainDispatcher(addrs[0], codec=args.codec)
+            disp.deploy(stages, params, addrs, batch=width,
+                        codecs=hop_codecs)
+
+            def cleanup(_threads=threads):
+                for t in _threads:
+                    t.join(timeout=10)
+        backend = ChainBackend(disp, width,
+                               tuple(stages[0].in_spec.shape),
+                               window=args.window)
+        door = ServeFrontDoor(backend=backend, listen=args.listen,
+                              tenants=tenants,
+                              gather_s=args.gather_ms / 1e3)
+    door.start()
+    print(json.dumps({"serving": f"{door.address[0]}:{door.address[1]}",
+                      "mode": door.mode, "width": door.width,
+                      "model": args.model, "stages": args.stages}),
+          flush=True)
+    try:
+        deadline = time.monotonic() + args.seconds if args.seconds > 0 \
+            else None
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.5 if deadline is None
+                       else min(0.5, max(0.0,
+                                         deadline - time.monotonic())))
+            # a dead backend/engine loop must fail the process, not
+            # silently serve nothing until the timer runs out
+            door.healthcheck()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        door.stop()
+        cleanup()
+        print(json.dumps({"final_stats": door.stats()}), flush=True)
+
+
+def cmd_serve_client(args):
+    """Load-generating client: play a deterministic open-loop Poisson
+    arrival trace (optional burst phases) against a front door and
+    print the latency/shed summary (docs/SERVING.md)."""
+    from .serve import LoadGenerator, ServeClient, poisson_trace
+
+    host, _, port = args.connect.rpartition(":")
+    bursts = []
+    for spec in args.burst or []:
+        t0, t1, mult = spec.split(":")
+        bursts.append((float(t0), float(t1), float(mult)))
+    offsets = poisson_trace(args.rate, args.seconds, seed=args.seed,
+                            bursts=bursts or None)
+    rng = np.random.default_rng(args.seed)
+    hello = {}
+    if args.max_new:
+        hello["max_new_tokens"] = args.max_new
+    if args.prompt_len:
+        samples = [rng.integers(0, args.vocab, (args.prompt_len,))
+                   .astype(np.int32) for _ in range(max(1, len(offsets)))]
+    else:
+        shape = tuple(int(d) for d in args.sample_shape.split(",") if d)
+        samples = [rng.standard_normal(shape).astype(np.float32)
+                   for _ in range(max(1, min(64, len(offsets))))]
+    client = ServeClient(host or "127.0.0.1", int(port), args.tenant,
+                         weight=args.weight, priority=args.priority,
+                         deadline_ms=args.deadline_ms or None, **hello)
+    print(json.dumps(LoadGenerator(client, samples, offsets).run()),
+          flush=True)
+
+
+def _render_serve_stats(doc: dict) -> None:
+    """Per-tenant serving columns of the monitor (docs/SERVING.md)."""
+    print(f"serve: mode={doc.get('mode')} width={doc.get('width')} "
+          f"frames={doc.get('frames')} queued={doc.get('queued')} "
+          f"inflight={doc.get('inflight')} service~"
+          f"{doc.get('service_estimate_ms')}ms")
+    print(f"{'TENANT':>12} {'W':>5} {'PRI':>3} {'QUEUED':>6} {'ADM':>7} "
+          f"{'SHED':>6} {'DONE':>7} {'QDELAY P50':>11} {'P99 MS':>8}")
+    for name, r in (doc.get("tenants") or {}).items():
+        qd = r.get("queue_delay_s") or {}
+        p50 = (qd.get("p50", 0.0) or 0.0) * 1e3 if qd.get("count") else 0.0
+        p99 = (qd.get("p99", 0.0) or 0.0) * 1e3 if qd.get("count") else 0.0
+        print(f"{name:>12} {r.get('weight', 1):>5.1f} "
+              f"{r.get('priority', 0):>3} {r.get('queued', 0):>6} "
+              f"{r.get('admitted', 0):>7} {r.get('shed', 0):>6} "
+              f"{r.get('completed', 0):>7} {p50:>11.3f} {p99:>8.3f}")
+
+
 def cmd_monitor(args):
     """Live chain observability: subscribe to every node's obs_push
     stream (passively estimating each node's clock offset; --align to
@@ -728,9 +914,10 @@ def cmd_monitor(args):
     from .obs.cluster import (ClusterView, StragglerDetector,
                               expected_stage_ms)
 
-    addrs = [a for a in args.nodes.split(",") if a]
-    if not addrs:
-        raise SystemExit("monitor requires --nodes host:port[,...]")
+    addrs = [a for a in (args.nodes or "").split(",") if a]
+    if not addrs and not args.serve:
+        raise SystemExit("monitor requires --nodes host:port[,...] "
+                         "and/or --serve host:port")
     detector = plan = graph = None
     if args.plan:
         from .plan import plan_from_json
@@ -742,14 +929,25 @@ def cmd_monitor(args):
         if args.model:
             graph = _get_model(args.model)
     view = ClusterView()
-    view.connect(addrs, interval_ms=args.interval_ms,
-                 align_clocks=args.align,
-                 timeout_s=args.connect_timeout)
+    if addrs:
+        view.connect(addrs, interval_ms=args.interval_ms,
+                     align_clocks=args.align,
+                     timeout_s=args.connect_timeout)
     try:
         i = 0
         while True:
             time.sleep(args.interval_ms / 1e3)
             i += 1
+            serve_doc = None
+            if args.serve:
+                from .serve.client import fetch_stats
+                host, _, port = args.serve.rpartition(":")
+                try:
+                    serve_doc = fetch_stats(host or "127.0.0.1",
+                                            int(port),
+                                            timeout_s=args.connect_timeout)
+                except (OSError, ConnectionError) as e:
+                    serve_doc = {"error": repr(e)}
             rows = view.rows()
             bott = view.bottleneck()
             flags = detector.observe(view) if detector is not None else []
@@ -765,6 +963,9 @@ def cmd_monitor(args):
                        "clock_offsets": {
                            a: round(v["offset_us"], 1)
                            for a, v in view.clock_offsets.items()}}
+                if serve_doc is not None:
+                    serve_doc.pop("cmd", None)
+                    doc["serve"] = serve_doc
                 if suggestion is not None:
                     doc["replan"] = suggestion.to_json()
                 elif err is not None:
@@ -773,6 +974,8 @@ def cmd_monitor(args):
             else:
                 _render_monitor(rows, bott, flags, view.clock_offsets,
                                 clear=i > 1)
+                if serve_doc is not None:
+                    _render_serve_stats(serve_doc)
                 if suggestion is not None:
                     s = suggestion
                     print(f"replan: moved={s.moved} predicted "
@@ -1047,10 +1250,88 @@ def main(argv=None):
     _add_overlap_flags(c)
     _add_obs_flags(c)
 
+    sv = sub.add_parser("serve", help="multi-tenant serving front door: "
+                                      "admission + continuous batching "
+                                      "+ SLO shedding over one chain "
+                                      "(docs/SERVING.md)")
+    sv.add_argument("--model", default="resnet_tiny")
+    sv.add_argument("--stages", type=int, default=3)
+    sv.add_argument("--cuts")
+    sv.add_argument("--workload", choices=["tensor", "decode"],
+                    default="tensor",
+                    help="tensor: samples through the deployed chain; "
+                         "decode: continuous-batching autoregressive "
+                         "generation (gpt* models, prompts in / token "
+                         "ids out)")
+    sv.add_argument("--listen", default="127.0.0.1:0",
+                    metavar="[host]:port")
+    sv.add_argument("--nodes", default="", metavar="host:port,...",
+                    help="deploy onto these already-running stage nodes "
+                         "(one per stage); default: thread-per-stage "
+                         "nodes inside this process")
+    sv.add_argument("--width", type=int, default=0, metavar="W",
+                    help="microbatch width (slots per frame); 0 = from "
+                         "--budget-ms, else 4")
+    sv.add_argument("--budget-ms", type=float, default=0.0,
+                    help="per-stage latency budget: width becomes the "
+                         "largest batch whose slowest stage stays "
+                         "inside it (plan.max_batch_within_budget)")
+    sv.add_argument("--max-width", type=int, default=64)
+    sv.add_argument("--batch", type=int, default=1,
+                    help="cost-model batch for --budget-ms sizing")
+    sv.add_argument("--window", type=int, default=8,
+                    help="formed frames in flight inside the chain")
+    sv.add_argument("--gather-ms", type=float, default=0.0,
+                    help="how long a partial frame waits for company "
+                         "(0 = never: the pipeline is the batching "
+                         "window)")
+    sv.add_argument("--codec", default="raw")
+    sv.add_argument("--hop-codecs", default="", metavar="C0,C1,...",
+                    help="per-stage outbound hop codecs for the "
+                         "deployed chain")
+    sv.add_argument("--tenant", action="append", default=[],
+                    metavar="NAME=W[:PRI[:DEADLINE_MS]]",
+                    help="pre-configure a tenant (repeatable): WFQ "
+                         "weight, strict priority, per-sample SLO")
+    sv.add_argument("--max-new", type=int, default=16,
+                    help="decode mode: default tokens per request")
+    sv.add_argument("--seconds", type=float, default=0.0,
+                    help="serve for N seconds then exit (0 = forever)")
+    sv.add_argument("--prom-port", type=int, default=None, metavar="PORT")
+    _add_cost_flags(sv)
+
+    sc = sub.add_parser("serve-client", help="open-loop load generator "
+                                             "against a serve front "
+                                             "door (Poisson + bursts)")
+    sc.add_argument("--connect", required=True, metavar="host:port")
+    sc.add_argument("--tenant", default="default")
+    sc.add_argument("--weight", type=float, default=1.0)
+    sc.add_argument("--priority", type=int, default=0)
+    sc.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-sample SLO carried in the hello (0 = "
+                         "no deadline)")
+    sc.add_argument("--rate", type=float, default=20.0,
+                    help="mean arrival rate (Hz)")
+    sc.add_argument("--seconds", type=float, default=5.0)
+    sc.add_argument("--seed", type=int, default=0)
+    sc.add_argument("--burst", action="append", default=[],
+                    metavar="T0:T1:MULT",
+                    help="burst phase: MULTx the base rate over "
+                         "[T0, T1) seconds (repeatable)")
+    sc.add_argument("--sample-shape", default="32,32,3",
+                    help="tensor mode: one sample's shape")
+    sc.add_argument("--prompt-len", type=int, default=0,
+                    help="decode mode: send random prompts of this "
+                         "length instead of tensors")
+    sc.add_argument("--vocab", type=int, default=97)
+    sc.add_argument("--max-new", type=int, default=0,
+                    help="decode mode: tokens per request (rides the "
+                         "hello)")
+
     mo = sub.add_parser("monitor", help="live top-style view of a "
                                         "running chain's obs_push "
                                         "telemetry")
-    mo.add_argument("--nodes", required=True, metavar="host:port,...",
+    mo.add_argument("--nodes", default="", metavar="host:port,...",
                     help="the chain nodes' listen addresses (same list "
                          "`stats`/deploy use)")
     mo.add_argument("--interval-ms", type=float, default=500.0,
@@ -1074,6 +1355,10 @@ def main(argv=None):
     mo.add_argument("--sustain", type=int, default=2,
                     help="reporting intervals a deviation must hold "
                          "before it is flagged")
+    mo.add_argument("--serve", default="", metavar="host:port",
+                    help="also poll a serve front door's stats endpoint "
+                         "and render per-tenant columns (admitted / "
+                         "shed / queue-delay percentiles)")
     mo.add_argument("--align", action="store_true",
                     help="actively clock-ALIGN every node's tracer to "
                          "this process (default: passively estimate "
@@ -1122,7 +1407,8 @@ def main(argv=None):
     {"models": cmd_models, "partition": cmd_partition, "plan": cmd_plan,
      "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
      "chain": cmd_chain, "monitor": cmd_monitor, "train": cmd_train,
-     "generate": cmd_generate}[args.cmd](args)
+     "generate": cmd_generate, "serve": cmd_serve,
+     "serve-client": cmd_serve_client}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -23,6 +23,57 @@ from .registry import REGISTRY
 from .trace import tracer
 
 
+class WatermarkSplit:
+    """Per-subscriber fan-out of reset-on-read channel watermarks.
+
+    A channel's ``take_watermark()`` is destructive — the peak since the
+    LAST read, whoever read it.  With two concurrent subscribers (the
+    serve front door's shedding loop and a human ``monitor``) each would
+    see only the peaks since ANY subscriber's last push, splitting a
+    burst across their reports.  This splitter is the node-side fix
+    (CHANGES.md PR 5 known issue): every underlying take is folded into
+    EVERY registered subscriber's running maximum, and a subscriber's
+    own take drains only ITS accumulator — each subscriber sees the true
+    peak since its own last read.
+
+    Unregistered callers (direct ``obs_snapshot`` calls, tests) still
+    get the raw fold — their reads never subtract from a subscriber's
+    view.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: dict[int, dict[str, int]] = {}
+
+    def register(self, sid: int) -> None:
+        with self._lock:
+            self._subs.setdefault(sid, {})
+
+    def unregister(self, sid: int) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    def subscribers(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def take(self, sid: int | None, key: str, chan) -> int:
+        """Fold ``chan``'s watermark into every subscriber's view and
+        return subscriber ``sid``'s accumulated peak (raw fold for
+        ``sid=None`` / unknown)."""
+        if chan is None:
+            return 0
+        with self._lock:
+            hi = int(chan.take_watermark())
+            for acc in self._subs.values():
+                if hi > acc.get(key, 0):
+                    acc[key] = hi
+            acc = self._subs.get(sid) if sid is not None else None
+            if acc is None:
+                return hi
+            return acc.pop(key, 0)
+
+
 class ObsReporter(threading.Thread):
     """Per-subscription push thread (one per ``obs_subscribe``).
 
@@ -46,24 +97,43 @@ class ObsReporter(threading.Thread):
         # checked — shadowing it with an Event breaks that call
         self._halt = threading.Event()
         self._cursor = tracer().span_cursor()
+        #: per-subscriber identity for the source's watermark splitter
+        #: (each subscription sees peaks since ITS own last push)
+        self.sid = id(self)
 
     def run(self) -> None:
         from ..transport.framed import send_ctrl
+        register = getattr(self._source, "obs_register", None)
+        if register is not None:
+            register(self.sid)
         seq = 0
-        while not self._halt.is_set():
-            try:
-                payload, self._cursor = self._source.obs_snapshot(
-                    cursor=self._cursor, include_spans=self._spans,
-                    span_limit=self._span_limit)
-                payload["cmd"] = "obs_push"
-                payload["push_seq"] = seq
-                payload["interval_ms"] = round(self.interval_s * 1e3, 3)
-                payload["t_us"] = tracer().now_us()
-                send_ctrl(self._conn, payload)
-            except (OSError, ValueError):
-                return  # subscriber gone / socket closed: self-clean
-            seq += 1
-            self._halt.wait(self.interval_s)
+        try:
+            while not self._halt.is_set():
+                try:
+                    payload, self._cursor = self._source.obs_snapshot(
+                        cursor=self._cursor, include_spans=self._spans,
+                        span_limit=self._span_limit,
+                        subscriber=self.sid)
+                except TypeError:
+                    # source predates per-subscriber watermark splitting
+                    payload, self._cursor = self._source.obs_snapshot(
+                        cursor=self._cursor, include_spans=self._spans,
+                        span_limit=self._span_limit)
+                try:
+                    payload["cmd"] = "obs_push"
+                    payload["push_seq"] = seq
+                    payload["interval_ms"] = round(
+                        self.interval_s * 1e3, 3)
+                    payload["t_us"] = tracer().now_us()
+                    send_ctrl(self._conn, payload)
+                except (OSError, ValueError):
+                    return  # subscriber gone / socket closed: self-clean
+                seq += 1
+                self._halt.wait(self.interval_s)
+        finally:
+            unregister = getattr(self._source, "obs_unregister", None)
+            if unregister is not None:
+                unregister(self.sid)
 
     def stop(self) -> None:
         self._halt.set()

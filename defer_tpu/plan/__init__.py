@@ -22,7 +22,8 @@ See ``docs/PLANNER.md`` for the model and the recurrence.
 
 from .cost import (CodecSpec, DEFAULT_CODECS, TIER_CODECS, StageCostModel,
                    bench_codec_instance, bench_codec_spec,
-                   calibrate_codecs)
+                   calibrate_codecs, max_batch_within_budget,
+                   stage_ms_at_batch)
 from .replan import (ReplanResult, corrected_cost_model,
                      cost_model_from_plan, measured_stage_seconds, replan)
 from .solver import (Plan, ReplicatedPlan, brute_force,
@@ -38,4 +39,5 @@ __all__ = [
     "sweep_nodes", "plan_from_json",
     "ReplanResult", "replan", "measured_stage_seconds",
     "corrected_cost_model", "cost_model_from_plan",
+    "max_batch_within_budget", "stage_ms_at_batch",
 ]

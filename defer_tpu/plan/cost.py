@@ -358,6 +358,24 @@ class StageCostModel:
                 best_name, best = n, s
         return best_name, best
 
+    def at_batch(self, batch: int) -> "StageCostModel":
+        """A shallow copy scoring the SAME graph at a different frame
+        batch — the serving front door's latency-budget query
+        (:func:`max_batch_within_budget`) sweeps this.  Analytic costs
+        scale themselves; measured ``node_costs`` (taken as-is at the
+        model's own batch) are scaled LINEARLY from it — an honest
+        first-order approximation (per-sample cost rarely shrinks with
+        batch on a saturated stage, so the query errs toward smaller,
+        latency-safer batches when the real curve is sublinear)."""
+        batch = max(1, int(batch))
+        other = copy.copy(self)
+        if self.node_costs is not None:
+            scale = batch / self.batch
+            other.node_costs = {k: v * scale
+                                for k, v in self.node_costs.items()}
+        other.batch = batch
+        return other
+
     def describe(self) -> dict:
         d = {
             "gen": self.gen, "batch": self.batch,
@@ -371,3 +389,49 @@ class StageCostModel:
             d["hop_tiers"] = dict(sorted(self.hop_tiers.items()))
             d["local_bw_s"] = self.local_bw_s
         return d
+
+
+# -- latency-budget queries (serving front door) ----------------------------
+
+def stage_ms_at_batch(graph: LayerGraph, cuts: list[str],
+                      cost: StageCostModel, batch: int) -> list[float]:
+    """Per-stage effective milliseconds (max of compute and hop comm) of
+    the ``cuts`` partition at frame ``batch`` — the planner's
+    ``stage_effective_ms`` re-evaluated at a candidate microbatch width.
+    The continuous-batching scheduler reads its per-stage latency budget
+    off this curve (docs/SERVING.md)."""
+    from .solver import evaluate_cuts
+    plan = evaluate_cuts(graph, list(cuts), cost.at_batch(batch))
+    return [s * 1e3 for s in plan.stage_cost_s]
+
+
+def max_batch_within_budget(graph: LayerGraph, cuts: list[str],
+                            cost: StageCostModel, budget_ms: float, *,
+                            cap: int = 256) -> int:
+    """Largest frame batch whose SLOWEST stage stays within
+    ``budget_ms`` — how ``defer_tpu serve`` sizes its dynamic
+    microbatches from the planner's cost model instead of a guessed
+    constant.  Monotone search (stage time never shrinks with batch
+    under this model): geometric probe then bisection.  Always >= 1:
+    a budget no batch can meet degrades to latency-optimal singles
+    rather than refusing to serve.
+    """
+    if budget_ms <= 0:
+        return 1
+
+    def worst_ms(b: int) -> float:
+        return max(stage_ms_at_batch(graph, cuts, cost, b))
+
+    if worst_ms(1) > budget_ms:
+        return 1
+    lo, hi = 1, 2
+    while hi <= cap and worst_ms(hi) <= budget_ms:
+        lo, hi = hi, hi * 2
+    hi = min(hi, cap + 1)
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        if worst_ms(mid) <= budget_ms:
+            lo = mid
+        else:
+            hi = mid
+    return lo

@@ -39,13 +39,18 @@ from typing import Any, Sequence
 import numpy as np
 
 from ..obs import REGISTRY, LatencyHistogram, new_span_id, tracer
-from ..obs.report import ObsReporter
+from ..obs.report import ObsReporter, WatermarkSplit
 from ..transport.channel import AsyncReceiver, AsyncSender, _sampled
 from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
                                 K_TENSOR_SEQ, configure_socket,
                                 connect_retry, recv_expect, recv_frame,
                                 send_ack, send_ctrl, send_end, send_frame)
 from ..transport.replicate import FanInMerge, FanOutSender
+
+
+#: guards lazy creation of per-node watermark splitters (``__new__``-
+#: built test stubs have no __init__ to create one in)
+_WM_LOCK = threading.Lock()
 
 
 def _connect_retry(host: str, port: int, timeout_s: float = 30.0
@@ -123,6 +128,9 @@ class StageNode:
     #: thread chains share across nodes — this instance copy keeps
     #: stats/obs_push attribution per node everywhere
     infer_hist: LatencyHistogram | None = None
+    #: per-subscriber watermark splitter (class default covers
+    #: ``__new__``-built stubs; created lazily under ``_WM_LOCK``)
+    _wm_split: WatermarkSplit | None = None
 
     def __init__(self, artifact: str | None, listen: str,
                  next_hop: str | None, *, codec: str = "raw",
@@ -440,8 +448,23 @@ class StageNode:
         except (AttributeError, TypeError):
             return 0
 
+    def _wm(self) -> WatermarkSplit:
+        with _WM_LOCK:
+            if self._wm_split is None:
+                self._wm_split = WatermarkSplit()
+            return self._wm_split
+
+    def obs_register(self, sid: int) -> None:
+        """Register a push subscriber with the watermark splitter (one
+        per :class:`ObsReporter`; see ``WatermarkSplit``)."""
+        self._wm().register(sid)
+
+    def obs_unregister(self, sid: int) -> None:
+        self._wm().unregister(sid)
+
     def obs_snapshot(self, *, cursor: int = 0, include_spans: bool = True,
-                     span_limit: int = 256) -> tuple[dict, int]:
+                     span_limit: int = 256,
+                     subscriber: int | None = None) -> tuple[dict, int]:
         """One ``obs_push`` payload: identity, lifetime counters, queue
         depths + per-interval watermarks (reset on read), cumulative
         latency summaries, and — when tracing is live — the spans
@@ -451,11 +474,13 @@ class StageNode:
         GIL-atomic registry instrument, so the hot path never blocks on
         the reporter.
 
-        Watermarks are reset-on-read and therefore effectively
-        SINGLE-SUBSCRIBER: with several concurrent subscriptions each
-        sees only the peaks since ANY subscriber's last push, so a
-        burst may be split across their reports (cumulative counters
-        and histograms are unaffected)."""
+        Watermarks are reset-on-read at the CHANNEL, but split per
+        subscriber here (``subscriber`` = the reporter's id,
+        :class:`~defer_tpu.obs.report.WatermarkSplit`): every
+        registered subscription sees the true peak since ITS OWN last
+        push, so the serve front door's shedding loop and a human
+        ``monitor`` can watch the same chain without corrupting each
+        other's readings (the PR 5 single-subscriber caveat, fixed)."""
         m = self.manifest
         reg = REGISTRY
         rx, tx = self._live_rx, self._live_tx
@@ -478,8 +503,8 @@ class StageNode:
                 "rx_depth": self.rx_depth, "tx_depth": self.tx_depth,
                 "rx": rx.qsize() if rx is not None else 0,
                 "tx": tx.qsize() if tx is not None else 0,
-                "rx_hi": rx.take_watermark() if rx is not None else 0,
-                "tx_hi": tx.take_watermark() if tx is not None else 0,
+                "rx_hi": self._wm().take(subscriber, "rx", rx),
+                "tx_hi": self._wm().take(subscriber, "tx", tx),
                 "inflight": reg.gauge("node.inflight").value,
                 "merge": self._merge.qsize()
                 if self._merge is not None else 0,
@@ -691,6 +716,24 @@ class StageNode:
                         else:
                             self.tier_in = "tcp"
                         continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "req_meta":
+                        # serve-front-door request metadata: cascade
+                        # downstream immediately (docs/SERVING.md).
+                        # Relayed ahead of the still-in-flight dispatch
+                        # window on purpose — a meta may only move
+                        # EARLIER relative to its own frame (it is
+                        # processed before the frame at every stage),
+                        # never later, and the result-hop demux joins
+                        # meta to frame by seq; draining the window
+                        # here would cut serving traffic's compute-
+                        # ahead to one frame
+                        stream_marked = True
+                        if tx is None:
+                            tx, out_socks = self._make_tx(
+                                connect_timeout_s)
+                        tx.send_ctrl(value)
+                        continue
                     is_trace = (isinstance(value, dict)
                                 and value.get("cmd") == "trace")
                     if is_trace:
@@ -717,6 +760,10 @@ class StageNode:
                         "--artifact or deploy in-band first)")
                 if tx is None:
                     tx, out_socks = self._make_tx(connect_timeout_s)
+                if self._live_rx is not rx:
+                    # first tensor on this channel (tx may already be
+                    # open from a req_meta cascade): bind the live
+                    # telemetry to the channel the stream actually rides
                     rx.bind_gauge("node.rx_queue_depth")
                     rx.bind_hist("node.rx_s")
                     rx.sample_every = self.trace_sample_every
@@ -795,6 +842,22 @@ class StageNode:
                         from ..transport.local import answer_probe
                         answer_probe(conn, value, accept=False)
                         self.tier_in = "tcp"
+                        continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "req_meta":
+                        # serve request metadata: cascade downstream in
+                        # stream order (the serial loop is already
+                        # strictly ordered — no window to drain)
+                        stream_marked = True
+                        if out is None:
+                            if self.next_hop is None:
+                                raise ValueError("no next hop configured")
+                            out = _connect_retry(
+                                *self.next_hop,
+                                timeout_s=connect_timeout_s)
+                            if self._pending_trace is not None:
+                                send_ctrl(out, self._pending_trace)
+                        send_ctrl(out, value)
                         continue
                     self._handle_ctrl(conn, value)
                     if (isinstance(value, dict)
@@ -1383,6 +1446,100 @@ class ChainDispatcher:
                 s.close()
         return out
 
+    def _ensure_result_chan(self) -> None:
+        """Accept the last node's dial-back and wrap it in the result
+        :class:`AsyncReceiver` (idempotent)."""
+        if self._res_conn is None:
+            self._res_conn, _ = self._res_srv.accept()
+            configure_socket(self._res_conn)
+        if self._rx_chan is None:
+            self._res_conn.settimeout(None)
+            self._rx_chan = AsyncReceiver(self._res_conn,
+                                          depth=self.rx_depth,
+                                          gauge="chain.rx_queue_depth",
+                                          span="chain",
+                                          hist="chain.rx_s")
+            self._rx_chan.sample_every = self.trace_sample_every
+
+    def _result_item(self, *, timeout_s: float | None = None
+                     ) -> tuple[int, Any]:
+        """One frame off the result hop with the transport handshake
+        handled: tier probes are answered (and the channel swapped on a
+        grant), trace / stream_begin markers — which the dispatcher
+        itself originated — are skipped; everything else is returned to
+        the caller."""
+        self._ensure_result_chan()
+        t = self.timeout_s if timeout_s is None else timeout_s
+        while True:
+            kind, y = self._rx_chan.get(timeout=t)
+            if kind == K_CTRL and isinstance(y, dict):
+                cmd = y.get("cmd")
+                if cmd == "tier_probe":
+                    # the last node offers the colocated fast path on its
+                    # result dial-back: granted, results swap to the
+                    # in-memory pipe (the socket stays as lifetime anchor)
+                    from ..transport.local import answer_probe
+                    pipe = answer_probe(self._res_conn, y,
+                                        accept=self.tier_accept)
+                    if pipe is not None:
+                        old = self._rx_chan
+                        self._rx_chan = pipe.receiver
+                        self._rx_chan.sample_every = \
+                            self.trace_sample_every
+                        self._rx_chan.bind_gauge("chain.rx_queue_depth")
+                        old.release_gauge()
+                        self.tier_in = "local"
+                    else:
+                        self.tier_in = "tcp"
+                    continue
+                if cmd in ("trace", "stream_begin"):
+                    continue
+            return kind, y
+
+    # -- serve front door: request-scoped duplex stream --------------------
+
+    def send_request_frame(self, arr: np.ndarray, *, seq: int,
+                           meta: dict | None = None) -> None:
+        """One request-scoped frame into the chain (docs/SERVING.md):
+        the frame is stamped with ``seq`` (wire protocol v2
+        ``K_TENSOR_SEQ`` — every stage relays the stamp unchanged, so
+        the result hop identifies the frame it answers), optionally
+        preceded by a ``req_meta`` K_CTRL frame carrying its
+        tenant/request composition, which stage nodes cascade
+        downstream ahead of (never behind) the frame it describes.
+        Requires a non-replicated chain
+        (a fan-out re-stamps sequence numbers and cannot order metadata
+        across paths)."""
+        self._ensure_connected()
+        if isinstance(self._tx_chan, FanOutSender) \
+                or self.result_fan_in > 1:
+            raise ValueError(
+                "request-scoped streaming requires a non-replicated "
+                "first/last stage (fan paths re-stamp seq numbers)")
+        if meta is not None:
+            msg = {"cmd": "req_meta", "seq": int(seq)}
+            msg.update(meta)
+            self._tx_chan.send_ctrl(msg)
+        self._tx_chan.send(np.asarray(arr), seq=int(seq))
+
+    def recv_result(self, *, timeout_s: float | None = None):
+        """Next item off the result hop for a request-scoped stream:
+        ``("meta", msg)`` for a cascaded ``req_meta`` frame, ``("tensor",
+        (seq, arr))`` for a result (``seq`` None on unstamped frames),
+        ``("end", None)`` when the chain drained."""
+        kind, y = self._result_item(timeout_s=timeout_s)
+        if kind == K_CTRL and isinstance(y, dict) \
+                and y.get("cmd") == "req_meta":
+            return "meta", y
+        if kind == K_TENSOR_SEQ:
+            return "tensor", (y[0], y[1])
+        if kind == K_TENSOR:
+            return "tensor", (None, y)
+        if kind == K_END:
+            return "end", None
+        raise ConnectionError(
+            f"unexpected frame kind {kind!r} on the result hop")
+
     def _recv_tensor(self) -> np.ndarray:
         """One in-order result frame; loud protocol check (not an assert:
         ``python -O`` strips asserts, and an early END from a node that died
@@ -1400,42 +1557,7 @@ class ChainDispatcher:
         """
         if self.result_fan_in > 1:
             return self._recv_tensor_fanin()
-        if self._res_conn is None:
-            self._res_conn, _ = self._res_srv.accept()
-            configure_socket(self._res_conn)
-        if self._rx_chan is None:
-            self._res_conn.settimeout(None)
-            self._rx_chan = AsyncReceiver(self._res_conn,
-                                          depth=self.rx_depth,
-                                          gauge="chain.rx_queue_depth",
-                                          span="chain",
-                                          hist="chain.rx_s")
-            self._rx_chan.sample_every = self.trace_sample_every
-        kind, y = self._rx_chan.get(timeout=self.timeout_s)
-        while kind == K_CTRL and isinstance(y, dict):
-            cmd = y.get("cmd")
-            if cmd == "tier_probe":
-                # the last node offers the colocated fast path on its
-                # result dial-back: granted, results swap to the
-                # in-memory pipe (the socket stays as lifetime anchor)
-                from ..transport.local import answer_probe
-                pipe = answer_probe(self._res_conn, y,
-                                    accept=self.tier_accept)
-                if pipe is not None:
-                    old = self._rx_chan
-                    self._rx_chan = pipe.receiver
-                    self._rx_chan.sample_every = self.trace_sample_every
-                    self._rx_chan.bind_gauge("chain.rx_queue_depth")
-                    old.release_gauge()
-                    self.tier_in = "local"
-                else:
-                    self.tier_in = "tcp"
-            elif cmd not in ("trace", "stream_begin"):
-                break  # not ours to skip: the kind check below reports
-            # trace / stream_begin: the last node cascaded the trace
-            # context / stream marker to the result hop; informational —
-            # the dispatcher originated it
-            kind, y = self._rx_chan.get(timeout=self.timeout_s)
+        kind, y = self._result_item()
         if kind == K_TENSOR_SEQ:
             # waterfall sampling stamps every frame end to end; the
             # result hop carries the stamp through — strip it here

@@ -1,0 +1,389 @@
+"""Continuous-batching autoregressive decode: per-request KV state rides
+through the pipeline stages; requests join and leave between steps.
+
+:class:`~defer_tpu.runtime.decode.PipelinedDecoder` decodes one CLOSED
+batch: every sequence enters together, decodes in lockstep, and exits
+together — a serving system driving it would pay head-of-line blocking
+(a 512-token request holds a 5-token request's slot hostage) and refill
+bubbles (the whole batch must drain before new prompts enter).  This
+engine is continuous batching proper:
+
+* The batch is ``width`` SLOTS.  Each slot holds one request's state —
+  its prompt, its position, and its OWN KV cache rows in every stage's
+  cache (``[blocks, width, kv_heads, max_len, head_dim]`` per stage, the
+  stage-sharded layout of ``runtime/decode.py`` with the group axis
+  replaced by a slot axis).
+* Between any two decode steps, finished requests leave (slot freed,
+  tokens delivered) and waiting requests join (slot claimed, position
+  0); the step program itself never changes — one compiled program per
+  width serves every batch composition.
+* A step is one token per active slot: teacher-forced from the prompt
+  while ``pos < prompt_len`` (prefill at decode rate — a joining
+  request needs no separate prefill program), sampled past it.  Every
+  row's computation is vmapped single-row decode against its own cache
+  at its own position, so a row's output bytes are INDEPENDENT of who
+  shares the batch — per-request outputs are byte-identical to the
+  request run alone, the correctness bar continuous batching must meet.
+* Sampling keys are ``fold_in(request_seed, position)`` per row —
+  deterministic per request regardless of batch composition or join
+  step.
+
+The stage structure mirrors the deployed chain's partition (same
+``_split_blocks`` assignment), so the planner's per-stage latency budget
+(``plan.cost.stage_ms_at_batch``) prices this engine's step the same way
+it prices a chain frame.  Execution here is in-process (one jitted step
+over the stage-structured state); carrying the per-slot caches through
+OS-process stage nodes needs stateful stage artifacts — the documented
+next step (docs/SERVING.md), not this PR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.ir import LayerGraph
+from ..models.gpt import CausalTransformerBlock, GptEmbedding
+from ..obs import REGISTRY
+from ..runtime.decode import _sample_ids, _split_blocks
+
+
+@dataclasses.dataclass
+class DecodeRequest:
+    """One admitted generation request."""
+
+    prompt: np.ndarray                 #: [prompt_len] int token ids
+    max_new_tokens: int
+    tenant: str = "default"
+    request_id: int = 0
+    seed: int = 0
+    temperature: float = 0.0
+    #: called with the finished [prompt_len + new] int64 ids (or None on
+    #: cancellation) from the engine's step thread
+    on_done: Callable[[Any], None] | None = None
+    queued_at: float = 0.0
+    #: set by the front door when the client disconnects while this
+    #: request is still queued — the engine loop must not join it
+    cancelled: bool = False
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+class _Slot:
+    __slots__ = ("req", "pos", "out", "last_id", "cancelled")
+
+    def __init__(self, req: DecodeRequest):
+        self.req = req
+        self.pos = 0               #: next position to feed
+        self.out: list[int] = []   #: generated ids
+        self.last_id = 0           #: last sampled id (input past prompt)
+        self.cancelled = False
+
+
+class ContinuousBatchEngine:
+    """Step-wise decoder over ``width`` request slots.
+
+    The engine is PASSIVE: callers (the front door's decode loop, or a
+    test) drive it with :meth:`join` / :meth:`cancel` between calls to
+    :meth:`step`.  All three must be called from one scheduling thread
+    (the slot table is not locked against concurrent mutation; the
+    front door owns that thread)."""
+
+    def __init__(self, graph: LayerGraph, params: dict[str, Any], *,
+                 num_stages: int, width: int,
+                 max_len: int | None = None, top_k: int | None = None):
+        if width < 1:
+            raise ValueError(f"width must be >= 1, got {width}")
+        nodes = graph.nodes
+        for req in ("embeddings", "final_ln", "lm_head"):
+            if req not in nodes:
+                raise ValueError(
+                    f"decode engine needs the gpt() node contract; "
+                    f"missing {req!r} (models/gpt.py)")
+        self.graph = graph
+        self.params = jax.tree.map(jnp.asarray, params)
+        self.width = width
+        self.num_stages = num_stages
+        self.embed_op: GptEmbedding = nodes["embeddings"].op
+        self.max_len = max_len or self.embed_op.max_len
+        if self.max_len > self.embed_op.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the positional table "
+                f"({self.embed_op.max_len})")
+        block_names = [nm for nm in graph.topo_order
+                       if nm.startswith("block_")]
+        for nm in block_names:
+            if not isinstance(nodes[nm].op, CausalTransformerBlock):
+                raise TypeError(f"{nm} is not a CausalTransformerBlock")
+        assign = _split_blocks(len(block_names), num_stages)
+        #: the chain-partition structure: stage s owns these blocks (and
+        #: their slice of every slot's KV state)
+        self.stage_blocks = [[block_names[i] for i in idxs]
+                             for idxs in assign]
+        blk0 = nodes[block_names[0]].op
+        self.d_model = nodes[block_names[0]].out_spec.shape[-1]
+        self.kv_heads = blk0.kv_heads
+        self.head_dim = self.d_model // blk0.num_heads
+        self.top_k = top_k
+
+        self._slots: list[_Slot | None] = [None] * width
+        self._caches = self._init_caches()
+        self._step_fns: dict[bool, Any] = {}
+        self.steps = 0
+        self._step_hist = REGISTRY.histogram("serve.decode.step_s")
+        self._tok_count = REGISTRY.counter("serve.decode.tokens")
+
+    # -- state -------------------------------------------------------------
+
+    def _init_caches(self):
+        w, kv, ml, hd = (self.width, self.kv_heads, self.max_len,
+                         self.head_dim)
+        return [{"k": jnp.zeros((len(blks), w, kv, ml, hd), jnp.float32),
+                 "v": jnp.zeros((len(blks), w, kv, ml, hd), jnp.float32)}
+                for blks in self.stage_blocks]
+
+    def free_slots(self) -> int:
+        return sum(1 for s in self._slots if s is None)
+
+    def active(self) -> int:
+        return self.width - self.free_slots()
+
+    def join(self, req: DecodeRequest) -> bool:
+        """Claim a free slot for ``req``; False when the batch is full.
+        The request's KV rows start clean by construction: position p's
+        cache row is written before any later position reads it, so a
+        recycled slot needs no cache zeroing."""
+        if req.prompt.size + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {req.prompt.size} + {req.max_new_tokens} new "
+                f"tokens exceeds max_len={self.max_len}")
+        for i, s in enumerate(self._slots):
+            if s is None:
+                self._slots[i] = _Slot(req)
+                return True
+        return False
+
+    def cancel(self, req: DecodeRequest) -> bool:
+        """Free ``req``'s slot immediately (client disconnected).  The
+        slot is reusable at the next join; other slots' rows are
+        untouched (row-independent step), so a mid-decode cancellation
+        cannot perturb anyone else's output."""
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req is req:
+                s.cancelled = True
+                self._slots[i] = None
+                if req.on_done is not None:
+                    req.on_done(None)
+                return True
+        return False
+
+    # -- the step program --------------------------------------------------
+
+    def _build_step(self, sample: bool):
+        nodes = self.graph.nodes
+        embed = self.embed_op
+        stage_ops = [[nodes[nm].op for nm in blks]
+                     for blks in self.stage_blocks]
+        stage_names = self.stage_blocks
+        final_ln = nodes["final_ln"].op
+        lm_head = nodes["lm_head"].op
+        top_k = self.top_k
+
+        def step(params, caches, ids, pos, seeds, temps):
+            safe = jnp.clip(pos, 0, self.max_len - 1)
+            x = (params["embeddings"]["wte"][ids]
+                 + params["embeddings"]["wpe"][safe]).astype(jnp.float32)
+            out_caches = []
+            # ride the stage partition: stage s applies its blocks
+            # against its slice of every slot's KV state
+            for s, (ops, names) in enumerate(zip(stage_ops, stage_names)):
+                ks, vs = caches[s]["k"], caches[s]["v"]
+                for l, (op, nm) in enumerate(zip(ops, names)):
+                    p_blk = params[nm]
+
+                    def row(x_r, k_r, v_r, pos_r, _op=op, _p=p_blk):
+                        y, k2, v2 = _op.decode(_p, x_r[None], k_r[None],
+                                               v_r[None], pos_r)
+                        return y[0], k2[0], v2[0]
+
+                    x, k_l, v_l = jax.vmap(row)(x, ks[l], vs[l], safe)
+                    ks = ks.at[l].set(k_l)
+                    vs = vs.at[l].set(v_l)
+                out_caches.append({"k": ks, "v": vs})
+            h = final_ln.apply(params["final_ln"], x)
+            logits = lm_head.apply(params["lm_head"],
+                                   h).astype(jnp.float32)
+            if sample:
+                def row_sample(lg, seed_r, pos_r, temp_r):
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(seed_r), pos_r)
+                    return _sample_ids(lg[None], temp_r, top_k, key)[0]
+                sampled = jax.vmap(row_sample)(logits, seeds, safe, temps)
+                ids_out = jnp.where(temps > 0, sampled,
+                                    jnp.argmax(logits, axis=-1))
+            else:
+                ids_out = jnp.argmax(logits, axis=-1)
+            return ids_out.astype(jnp.int32), out_caches
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    def _step_fn(self, sample: bool):
+        fn = self._step_fns.get(sample)
+        if fn is None:
+            fn = self._step_fns[sample] = self._build_step(sample)
+        return fn
+
+    # -- one decode step ---------------------------------------------------
+
+    def step(self) -> list[tuple[DecodeRequest, np.ndarray]]:
+        """Advance every active slot one token; returns requests that
+        FINISHED this step as ``(request, [plen + new] ids)`` (their
+        slots are already free).  No-op (empty list) with no active
+        slots."""
+        live = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+        if not live:
+            return []
+        w = self.width
+        ids = np.zeros(w, np.int32)
+        pos = np.zeros(w, np.int32)
+        seeds = np.zeros(w, np.uint32)
+        temps = np.zeros(w, np.float32)
+        sample = False
+        for i, s in live:
+            plen = s.req.prompt.size
+            ids[i] = s.req.prompt[s.pos] if s.pos < plen else s.last_id
+            pos[i] = s.pos
+            seeds[i] = s.req.seed & 0xFFFFFFFF
+            temps[i] = s.req.temperature
+            sample = sample or s.req.temperature > 0
+        t0 = time.perf_counter()
+        next_ids, self._caches = self._step_fn(sample)(
+            self.params, self._caches, jnp.asarray(ids), jnp.asarray(pos),
+            jnp.asarray(seeds), jnp.asarray(temps))
+        next_ids = np.asarray(next_ids)
+        dt = time.perf_counter() - t0
+        self._step_hist.record(dt)
+        self.steps += 1
+        done: list[tuple[DecodeRequest, np.ndarray]] = []
+        for i, s in live:
+            plen = s.req.prompt.size
+            tok = int(next_ids[i])
+            # the step consumed position s.pos; the token it produced
+            # sits at position s.pos + 1, generated iff past the prompt
+            if s.pos + 1 >= plen:
+                s.out.append(tok)
+                s.last_id = tok
+                self._tok_count.n += 1
+            s.pos += 1
+            if len(s.out) >= s.req.max_new_tokens:
+                result = np.concatenate(
+                    [s.req.prompt.astype(np.int64),
+                     np.asarray(s.out, np.int64)])
+                self._slots[i] = None
+                done.append((s.req, result))
+                if s.req.on_done is not None:
+                    s.req.on_done(result)
+        return done
+
+    # -- convenience (tests, sequential baselines) -------------------------
+
+    def run_all(self, requests, *, joiner=None, max_steps: int = 100_000
+                ) -> dict[int, np.ndarray]:
+        """Drive the engine until every request finished: join waiting
+        requests whenever slots free up (continuous batching), step
+        until drained.  ``joiner(engine, pending)`` can override join
+        order/timing (tests use it to stagger joins).  Returns
+        ``{request_id: ids}``."""
+        pending = list(requests)
+        results: dict[int, np.ndarray] = {}
+
+        def default_joiner(eng, queue):
+            while queue and eng.free_slots():
+                if not eng.join(queue[0]):
+                    break
+                queue.pop(0)
+
+        join = joiner or default_joiner
+        for _ in range(max_steps):
+            join(self, pending)
+            if not pending and self.active() == 0:
+                return results
+            for req, ids in self.step():
+                results[req.request_id] = ids
+        raise RuntimeError(f"run_all did not drain in {max_steps} steps")
+
+
+class EngineLoop(threading.Thread):
+    """The front door's decode scheduling thread: joins admitted
+    requests from a :class:`~defer_tpu.serve.batcher.BatchFormer` into
+    free slots between steps, steps while anything is active, parks on
+    the queue otherwise."""
+
+    def __init__(self, engine: ContinuousBatchEngine, former,
+                 on_service=None):
+        super().__init__(daemon=True, name="serve-decode-loop")
+        self.engine = engine
+        self.former = former
+        self._halt = threading.Event()
+        self.error: BaseException | None = None
+        #: called with (per-unit seconds, units) after each step — feeds
+        #: the admission controller's live service EWMA
+        self._on_service = on_service
+        #: cancellations queued from OTHER threads (client reader saw a
+        #: disconnect); applied between steps on THIS thread — the slot
+        #: table has exactly one mutating thread
+        self._cancel_q: list = []
+        self._cancel_lock = threading.Lock()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def request_cancel(self, req) -> None:
+        """Thread-safe: free ``req``'s slot at the next step boundary."""
+        with self._cancel_lock:
+            self._cancel_q.append(req)
+
+    def _apply_cancels(self) -> None:
+        with self._cancel_lock:
+            cancels, self._cancel_q = self._cancel_q, []
+        for req in cancels:
+            self.engine.cancel(req)
+
+    def run(self) -> None:
+        eng = self.engine
+        try:
+            while not self._halt.is_set():
+                self._apply_cancels()
+                free = eng.free_slots()
+                queue = self.former.queue
+                for j in range(free):
+                    # park on the queue only when idle; with work in
+                    # flight just sweep whatever is already waiting
+                    timeout = 0.05 if eng.active() == 0 and j == 0 else 0.0
+                    item = queue.pop(timeout=timeout)
+                    if item is None:
+                        break
+                    if getattr(item[1], "cancelled", False):
+                        continue  # client left while it queued
+                    eng.join(item[1])
+                if eng.active() == 0:
+                    continue
+                t0 = time.perf_counter()
+                n = eng.active()
+                eng.step()
+                if self._on_service is not None and n > 0:
+                    self._on_service((time.perf_counter() - t0) / n, n)
+        except BaseException as e:  # noqa: BLE001 — surfaced by the door
+            self.error = e

@@ -219,6 +219,109 @@ def test_obs_reporter_dead_thread_survives_is_alive_check():
     rep.stop()  # idempotent
 
 
+class _FakeChan:
+    """take_watermark() double with a settable peak."""
+
+    def __init__(self):
+        self.hi = 0
+
+    def take_watermark(self):
+        h, self.hi = self.hi, 0
+        return h
+
+    def qsize(self):
+        return 0
+
+    class _H:  # noqa: D106 — enc/dec histogram double
+        @staticmethod
+        def summary():
+            return {"count": 0}
+
+    enc = dec = _H()
+
+
+def _node_stub():
+    """A ``__new__``-built StageNode with just enough attributes for
+    obs_snapshot / the splitter."""
+    from defer_tpu.runtime.node import StageNode
+
+    node = StageNode.__new__(StageNode)
+    node._reporters = []
+    node.prog = None
+    node.address = ("127.0.0.1", 0)
+    node.codec = "raw"
+    node.processed = 0
+    node.reweights = 0
+    node._merge = None
+    return node
+
+
+def test_watermark_split_per_subscriber():
+    """The PR 5 known issue, fixed: reset-on-read watermarks are split
+    per subscriber — each sees the true peak since ITS own last read,
+    so a shedding loop and a human monitor can watch the same node."""
+    from defer_tpu.obs.report import WatermarkSplit
+
+    split = WatermarkSplit()
+    chan = _FakeChan()
+    split.register(1)
+    split.register(2)
+    chan.hi = 7
+    assert split.take(1, "rx", chan) == 7   # 1 drains the burst...
+    assert split.take(2, "rx", chan) == 7   # ...and 2 STILL sees it
+    assert split.take(1, "rx", chan) == 0   # nothing new since 1's read
+    chan.hi = 3
+    assert split.take(2, "rx", chan) == 3
+    chan.hi = 5
+    # an unregistered caller gets the raw fold without draining anyone
+    assert split.take(None, "rx", chan) == 5
+    assert split.take(1, "rx", chan) == 5
+    split.unregister(2)
+    assert split.subscribers() == 1
+    assert split.take(None, "rx", None) == 0  # dead channel: quiet zero
+
+
+def test_stage_node_watermarks_split_across_two_subscribers():
+    """Node-level: two concurrent obs_snapshot subscribers both see the
+    same queue burst instead of whoever-reads-first stealing it."""
+    node = _node_stub()
+    rx = _FakeChan()
+    node._live_rx = rx
+    node._live_tx = None
+    node.obs_register(101)
+    node.obs_register(202)
+    rx.hi = 9
+    p1, _ = node.obs_snapshot(subscriber=101, include_spans=False)
+    p2, _ = node.obs_snapshot(subscriber=202, include_spans=False)
+    assert p1["queues"]["rx_hi"] == 9
+    assert p2["queues"]["rx_hi"] == 9, \
+        "the second subscriber lost the burst to the first's reset"
+    p1b, _ = node.obs_snapshot(subscriber=101, include_spans=False)
+    assert p1b["queues"]["rx_hi"] == 0
+    node.obs_unregister(101)
+    node.obs_unregister(202)
+
+
+def test_obs_reporter_registers_with_watermark_splitter():
+    """An ObsReporter subscription registers its id with the source's
+    splitter and unregisters when the subscriber disconnects."""
+    from defer_tpu.obs import ObsReporter
+    from defer_tpu.transport.framed import K_CTRL, recv_frame
+
+    node = _node_stub()
+    a, b = socket.socketpair()
+    rep = ObsReporter(node, a, interval_s=0.02, spans=False)
+    rep.start()
+    kind, msg = recv_frame(b)
+    assert kind == K_CTRL and msg["cmd"] == "obs_push"
+    assert node._wm().subscribers() == 1
+    a.close()
+    b.close()
+    rep.join(timeout=10)
+    assert node._wm().subscribers() == 0, \
+        "a dead subscription must unregister from the splitter"
+
+
 # ---------------------------------------------------------------------------
 # clock alignment
 # ---------------------------------------------------------------------------

@@ -1,0 +1,540 @@
+"""Serving front door: admission (WFQ/priorities/shedding), continuous
+batching (chain + decode), request-scoped demux, and the edge cases the
+admission layer must survive (greedy neighbors, shed-then-retry,
+mid-decode disconnects).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import partition
+from defer_tpu.models import resnet_tiny
+from defer_tpu.models.gpt import gpt_tiny
+from defer_tpu.plan import StageCostModel, max_batch_within_budget, \
+    stage_ms_at_batch
+from defer_tpu.runtime.node import ChainDispatcher, StageNode
+from defer_tpu.serve import (AdmissionController, ContinuousBatchEngine,
+                             DecodeRequest, ServeClient, TenantConfig,
+                             WeightedFairQueue, poisson_trace)
+from defer_tpu.serve.client import fetch_stats
+from defer_tpu.serve.frontdoor import ChainBackend, ServeFrontDoor
+
+
+# ---------------------------------------------------------------------------
+# arrival traces
+# ---------------------------------------------------------------------------
+
+def test_poisson_trace_deterministic_and_bursty():
+    a = poisson_trace(50.0, 4.0, seed=7, bursts=[(1.0, 2.0, 3.0)])
+    b = poisson_trace(50.0, 4.0, seed=7, bursts=[(1.0, 2.0, 3.0)])
+    assert a == b, "same seed must reproduce the same trace"
+    assert a == sorted(a) and all(0 <= t < 4.0 for t in a)
+    c = poisson_trace(50.0, 4.0, seed=8, bursts=[(1.0, 2.0, 3.0)])
+    assert a != c
+    # the burst window must actually run ~3x hot vs the steady phases
+    in_burst = sum(1 for t in a if 1.0 <= t < 2.0)
+    steady = sum(1 for t in a if t < 1.0 or t >= 2.0) / 3.0
+    assert in_burst > 1.8 * steady, (in_burst, steady)
+
+
+def test_poisson_trace_validates_phases():
+    with pytest.raises(ValueError):
+        poisson_trace(10, 1, bursts=[(0.5, 0.2, 2.0)])
+    assert poisson_trace(0, 5) == []
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queuing
+# ---------------------------------------------------------------------------
+
+def test_wfq_fairness_bound_under_greedy_neighbor():
+    """A greedy tenant pre-loading its whole queue cannot starve a
+    steady neighbor: over any backlogged prefix the served counts track
+    the weight ratio to within one unit per tenant (the SFQ bound)."""
+    q = WeightedFairQueue()
+    q.configure(TenantConfig("greedy", weight=1.0))
+    q.configure(TenantConfig("steady", weight=1.0))
+    for i in range(60):
+        q.push("greedy", f"g{i}")  # the flood lands first
+    for i in range(10):
+        q.push("steady", f"s{i}")
+    served = [q.pop()[0] for _ in range(70)]
+    # while both are backlogged (first 20 pops), shares stay within the
+    # fairness bound despite greedy's 60-deep head start
+    for k in range(1, 21):
+        g = served[:k].count("greedy")
+        s = served[:k].count("steady")
+        assert abs(g - s) <= 1, (k, g, s)
+
+
+def test_wfq_weights_shape_the_share():
+    q = WeightedFairQueue()
+    q.configure(TenantConfig("heavy", weight=3.0))
+    q.configure(TenantConfig("light", weight=1.0))
+    for i in range(80):
+        q.push("heavy", i)
+        q.push("light", i)
+    first = [q.pop()[0] for _ in range(40)]
+    h, light = first.count("heavy"), first.count("light")
+    # 3:1 weights -> ~3:1 service while both are backlogged
+    assert 2.0 <= h / max(light, 1) <= 4.0, (h, light)
+
+
+def test_wfq_strict_priority_preempts():
+    q = WeightedFairQueue()
+    q.configure(TenantConfig("bulk", weight=5.0, priority=0))
+    q.configure(TenantConfig("interactive", weight=1.0, priority=1))
+    for i in range(5):
+        q.push("bulk", i)
+    q.push("interactive", "now")
+    assert q.pop()[0] == "interactive", \
+        "higher priority level must drain first regardless of weights"
+    assert q.pop()[0] == "bulk"
+
+
+def test_wfq_reconfigure_moves_priority_level():
+    """Review regression: re-configuring a tenant's priority must MOVE
+    its queue (items included) to the new level, and a later drop must
+    not corrupt the size accounting."""
+    q = WeightedFairQueue()
+    q.configure(TenantConfig("a", priority=0))
+    q.configure(TenantConfig("b", priority=0))
+    for i in range(3):
+        q.push("a", i)
+    q.push("b", "x")
+    q.configure(TenantConfig("a", priority=1))  # promote mid-backlog
+    assert q.pop()[0] == "a", "promoted tenant must drain first"
+    assert q.qsize() == 3
+    q.push("a", 99)  # new pushes land in the NEW level
+    assert q.pop()[0] == "a"
+    assert q.drop_tenant("a") == 2  # items 2 and 99 discarded
+    assert q.qsize("a") == 0 and q.qsize() == 1  # b's unit intact
+    assert q.pop() == ("b", "x") and q.qsize() == 0
+
+
+def test_wfq_blocking_pop_and_drop_tenant():
+    q = WeightedFairQueue()
+    q.configure(TenantConfig("a"))
+    assert q.pop(timeout=0.0) is None
+    t = threading.Timer(0.05, lambda: q.push("a", 1))
+    t.start()
+    assert q.pop(timeout=2.0) == ("a", 1)
+    q.push("a", 2)
+    q.push("a", 3)
+    assert q.drop_tenant("a") == 2 and q.qsize() == 0
+    q.push("a", 4)  # still configured after the drop
+    assert q.pop() == ("a", 4)
+
+
+# ---------------------------------------------------------------------------
+# admission / shedding
+# ---------------------------------------------------------------------------
+
+def test_admission_shed_then_retry_lifecycle():
+    """The satellite lifecycle: admit while the prediction fits, shed
+    with a retry hint when the backlog blows the deadline, admit again
+    once completions drain the backlog."""
+    ctl = AdmissionController(service_s=lambda: 0.1)
+    ctl.configure(TenantConfig("t", deadline_ms=250.0))
+    d1 = ctl.admit("t", "u1")
+    d2 = ctl.admit("t", "u2")
+    assert d1.admitted and d2.admitted
+    d3 = ctl.admit("t", "u3")  # predicted (2+1)*0.1 = 0.3 > 0.25
+    assert not d3.admitted and d3.reason == "deadline"
+    assert d3.retry_after_s > 0 and d3.predicted_s > 0.25
+    ctl.complete("t", queued_at=time.monotonic())
+    d4 = ctl.admit("t", "u3-retry")  # backlog drained below the SLO
+    assert d4.admitted
+    stats = ctl.stats()
+    assert stats["tenants"]["t"]["admitted"] == 3
+    assert stats["tenants"]["t"]["shed"] == 1
+    assert stats["tenants"]["t"]["completed"] == 1
+
+
+def test_admission_backlog_cap_sheds_without_deadline():
+    ctl = AdmissionController(service_s=lambda: 0.0)
+    ctl.configure(TenantConfig("t", max_queued=2))
+    assert ctl.admit("t", 1).admitted and ctl.admit("t", 2).admitted
+    d = ctl.admit("t", 3)
+    assert not d.admitted and d.reason == "backlog"
+
+
+def test_admission_ewma_and_per_tenant_isolation():
+    ctl = AdmissionController()
+    ctl.configure(TenantConfig("slo", deadline_ms=50.0))
+    ctl.configure(TenantConfig("besteffort"))  # no deadline: never SLO-shed
+    ctl.observe_service(0.2)
+    assert ctl.service_estimate_s() == pytest.approx(0.2)
+    assert not ctl.admit("slo", 1).admitted      # 0.2s >> 50ms
+    assert ctl.admit("besteffort", 1).admitted   # deadline-free rides on
+
+
+# ---------------------------------------------------------------------------
+# latency-budget queries (plan/)
+# ---------------------------------------------------------------------------
+
+def test_latency_budget_width_query():
+    g = resnet_tiny()
+    stages = partition(g, num_stages=3)
+    cuts = [s.output_name for s in stages[:-1]]
+    cm = StageCostModel(g, batch=1)
+    ms1 = max(stage_ms_at_batch(g, cuts, cm, 1))
+    ms8 = max(stage_ms_at_batch(g, cuts, cm, 8))
+    assert ms8 > ms1 > 0, "stage time must grow with batch"
+    assert max_batch_within_budget(g, cuts, cm, ms1 * 0.5) == 1, \
+        "a budget below the single-sample cost degrades to width 1"
+    w = max_batch_within_budget(g, cuts, cm, ms8, cap=64)
+    assert 8 <= w <= 64
+    assert max(stage_ms_at_batch(g, cuts, cm, w)) <= ms8 + 1e-9
+    big = max_batch_within_budget(g, cuts, cm, 1e9, cap=16)
+    assert big == 16, "an unbounded budget saturates the cap"
+
+
+def test_latency_budget_scales_measured_costs():
+    g = resnet_tiny()
+    stages = partition(g, num_stages=2)
+    cuts = [s.output_name for s in stages[:-1]]
+    node_costs = {n: 1e-4 for n in g.topo_order}
+    cm = StageCostModel(g, batch=1, node_costs=node_costs)
+    ms2 = stage_ms_at_batch(g, cuts, cm, 2)
+    ms1 = stage_ms_at_batch(g, cuts, cm, 1)
+    # measured costs scale linearly with the candidate batch
+    assert max(ms2) == pytest.approx(2 * max(ms1), rel=0.2)
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching decode engine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    g = gpt_tiny()
+    return g, g.init(jax.random.key(0))
+
+
+def _prompts(n, rng):
+    return [rng.integers(0, 97, (int(p),)).astype(np.int32)
+            for p in rng.integers(2, 6, n)]
+
+
+def test_engine_byte_identity_solo_vs_continuous(gpt_setup):
+    """The correctness bar: per-request outputs byte-identical to the
+    request run alone, with requests JOINING AT DIFFERENT STEPS (true
+    continuous batching, not lockstep), greedy and sampled rows mixed."""
+    g, params = gpt_setup
+    rng = np.random.default_rng(3)
+    prompts = _prompts(3, rng)
+
+    def make_reqs():
+        return [DecodeRequest(prompt=p, max_new_tokens=5, request_id=i,
+                              seed=100 + i,
+                              temperature=0.8 if i == 1 else 0.0)
+                for i, p in enumerate(prompts)]
+
+    solo = {}
+    for req in make_reqs():
+        eng = ContinuousBatchEngine(g, params, num_stages=2, width=3)
+        solo[req.request_id] = eng.run_all([req])[req.request_id]
+
+    eng = ContinuousBatchEngine(g, params, num_stages=2, width=3)
+
+    def stagger(e, queue):
+        while queue and e.free_slots() \
+                and e.steps >= 3 * queue[0].request_id:
+            e.join(queue.pop(0))
+
+    batched = eng.run_all(make_reqs(), joiner=stagger)
+    for rid, ids in solo.items():
+        np.testing.assert_array_equal(batched[rid], ids)
+
+
+def test_engine_cancel_reclaims_slot_others_unaffected(gpt_setup):
+    """A client disconnecting mid-decode: its slot is reclaimed (a new
+    request joins into it) and the surviving request's output is
+    byte-identical to an undisturbed run."""
+    g, params = gpt_setup
+    rng = np.random.default_rng(4)
+    p_victim, p_survivor, p_late = _prompts(3, rng)
+    solo_eng = ContinuousBatchEngine(g, params, num_stages=2, width=2)
+    survivor_solo = solo_eng.run_all(
+        [DecodeRequest(prompt=p_survivor, max_new_tokens=6,
+                       request_id=1)])[1]
+
+    eng = ContinuousBatchEngine(g, params, num_stages=2, width=2)
+    victim = DecodeRequest(prompt=p_victim, max_new_tokens=10,
+                           request_id=0)
+    survivor = DecodeRequest(prompt=p_survivor, max_new_tokens=6,
+                             request_id=1)
+    seen = []
+    victim.on_done = seen.append
+    assert eng.join(victim) and eng.join(survivor)
+    assert eng.free_slots() == 0
+    for _ in range(3):
+        eng.step()
+    assert eng.cancel(victim)
+    assert seen == [None], "cancellation must signal on_done(None)"
+    assert eng.free_slots() == 1, "the KV slot must be reclaimed"
+    late = DecodeRequest(prompt=p_late, max_new_tokens=2, request_id=2)
+    assert eng.join(late), "a new request must fit the reclaimed slot"
+    out = {}
+    while eng.active():
+        for req, ids in eng.step():
+            out[req.request_id] = ids
+    np.testing.assert_array_equal(out[1], survivor_solo)
+    assert 2 in out and eng.free_slots() == 2
+
+
+def test_engine_validates_requests(gpt_setup):
+    g, params = gpt_setup
+    eng = ContinuousBatchEngine(g, params, num_stages=2, width=1)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.join(DecodeRequest(prompt=np.arange(10), max_new_tokens=99))
+    with pytest.raises(ValueError, match="at least one token"):
+        DecodeRequest(prompt=np.zeros((0,)), max_new_tokens=1)
+    assert eng.join(DecodeRequest(prompt=np.arange(3), max_new_tokens=1))
+    assert not eng.join(
+        DecodeRequest(prompt=np.arange(3), max_new_tokens=1)), \
+        "a full batch refuses joins until a slot frees"
+
+
+# ---------------------------------------------------------------------------
+# request-scoped chain streaming (req_meta + seq namespace)
+# ---------------------------------------------------------------------------
+
+def _boot_chain(stages, params, batch, *, codecs=None):
+    nodes = [StageNode(None, "127.0.0.1:0", None) for _ in stages]
+    addrs = [f"127.0.0.1:{n.address[1]}" for n in nodes]
+    threads = [threading.Thread(target=n.serve, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    disp = ChainDispatcher(addrs[0], codec="raw")
+    disp.deploy(stages, params, addrs, batch=batch, codecs=codecs)
+    return disp, threads
+
+
+@pytest.fixture(scope="module")
+def resnet_setup():
+    g = resnet_tiny()
+    return g, g.init(jax.random.key(0))
+
+
+def test_req_meta_cascades_ahead_of_its_frame(resnet_setup):
+    """The node-side contract: a req_meta K_CTRL cascades through every
+    stage and arrives on the result hop BEFORE the frame it describes
+    (it may overtake earlier frames — the demux joins by seq), with the
+    v2 seq stamp relayed end to end and both kinds in send order."""
+    g, params = resnet_setup
+    stages = partition(g, num_stages=2)
+    disp, threads = _boot_chain(stages, params, 2)
+    try:
+        rng = np.random.default_rng(0)
+        xs = [rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+              for _ in range(3)]
+        for i, x in enumerate(xs):
+            disp.send_request_frame(x, seq=1000 + i,
+                                    meta={"slots": [["t", i, i, 0]]})
+        got = [disp.recv_result(timeout_s=60.0) for _ in range(6)]
+        metas = [v for k, v in got if k == "meta"]
+        tensors = [v for k, v in got if k == "tensor"]
+        assert len(metas) == 3 and len(tensors) == 3
+        assert [m["seq"] for m in metas] == [1000, 1001, 1002]
+        assert [m["slots"] for m in metas] == [[["t", i, i, 0]]
+                                               for i in range(3)]
+        assert [s for s, _ in tensors] == [1000, 1001, 1002]
+        for i in range(3):
+            at_meta = next(j for j, (k, v) in enumerate(got)
+                           if k == "meta" and v["seq"] == 1000 + i)
+            at_tensor = next(j for j, (k, v) in enumerate(got)
+                             if k == "tensor" and v[0] == 1000 + i)
+            assert at_meta < at_tensor, \
+                f"meta for frame {i} arrived after its tensor"
+    finally:
+        disp.close()
+        for t in threads:
+            t.join(timeout=30)
+
+
+def test_request_frames_reject_replicated_chains(resnet_setup):
+    disp = ChainDispatcher.__new__(ChainDispatcher)
+    disp.result_fan_in = 2
+    disp._send_sock = object()  # pretend connected
+    disp._tx_chan = object()
+    with pytest.raises(ValueError, match="non-replicated"):
+        disp.send_request_frame(np.zeros((1, 2)), seq=0)
+
+
+# ---------------------------------------------------------------------------
+# the front door end to end (tensor mode over an in-process chain)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tensor_door(resnet_setup):
+    g, params = resnet_setup
+    stages = partition(g, num_stages=2)
+    disp, threads = _boot_chain(stages, params, 4)
+    door = ServeFrontDoor(
+        backend=ChainBackend(disp, 4, (32, 32, 3))).start()
+    yield g, params, door
+    door.stop()
+    for t in threads:
+        t.join(timeout=30)
+
+
+def test_frontdoor_multitenant_byte_identity(tensor_door):
+    """Three concurrent tenant streams over ONE deployed chain: every
+    per-request output byte-identical to the request run alone through
+    the same serving path (the acceptance bar)."""
+    g, params, door = tensor_door
+    host, port = door.address
+    rng = np.random.default_rng(11)
+    data = {t: [rng.standard_normal((32, 32, 3)).astype(np.float32)
+                for _ in range(3)] for t in ("alpha", "beta", "gamma")}
+    solo = {t: ServeClient(host, port, t + "_solo").stream(data[t])
+            for t in data}
+    outs = {}
+
+    def run_tenant(t):
+        outs[t] = ServeClient(host, port, t).stream(data[t])
+
+    ths = [threading.Thread(target=run_tenant, args=(t,)) for t in data]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=120)
+    door.healthcheck()
+    for t in data:
+        for i in range(len(data[t])):
+            assert outs[t][i][0] == "ok" and solo[t][i][0] == "ok"
+            np.testing.assert_array_equal(outs[t][i][1], solo[t][i][1])
+    doc = fetch_stats(host, port)
+    assert doc["mode"] == "tensor" and doc["width"] == 4
+    assert doc["tenants"]["alpha"]["completed"] == 3
+
+
+def test_frontdoor_shed_reply_and_retry(tensor_door):
+    """Overload a deadline-bound tenant: the client receives shed
+    control frames (with prediction + retry hint) instead of late
+    results, and a retry after the backlog drains is served."""
+    g, params, door = tensor_door
+    host, port = door.address
+    # pin the service estimate high so the SLO math sheds immediately
+    # and deterministically (the live EWMA would need real overload)
+    door.admission._service_s = lambda: 0.5
+    try:
+        c = ServeClient(host, port, "slo_tenant", deadline_ms=600.0)
+        x = np.zeros((32, 32, 3), np.float32)
+        for _ in range(4):
+            c.submit(x)
+        # give the first admissions a moment to resolve, then retry
+        time.sleep(1.0)
+        retry_seq = c.submit(x)
+        results = c.finish()
+        outcomes = [results[q][0] for q in sorted(results)]
+        assert "shed" in outcomes, outcomes
+        shed = next(v for v in results.values() if v[0] == "shed")
+        assert shed[1]["reason"] == "deadline"
+        assert shed[1]["retry_after_ms"] > 0
+        assert shed[1]["predicted_ms"] > 600.0
+        assert results[retry_seq][0] == "ok", \
+            "a retry after the backlog drained must be admitted"
+    finally:
+        door.admission._service_s = None
+
+
+# ---------------------------------------------------------------------------
+# the front door end to end (decode mode) + disconnect mid-decode
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def decode_door():
+    # a longer positional table than gpt_tiny's 16 so the "victim" can
+    # run a generation long enough to be caught mid-decode
+    g = gpt_tiny(seq_len=48)
+    params = g.init(jax.random.key(0))
+    engine = ContinuousBatchEngine(g, params, num_stages=2, width=3)
+    door = ServeFrontDoor(engine=engine,
+                          decode_defaults={"max_new_tokens": 4}).start()
+    yield g, params, door
+    door.stop()
+
+
+def test_frontdoor_decode_roundtrip_and_disconnect(decode_door):
+    """Decode mode: concurrent tenants' generations are byte-identical
+    to solo runs; a client disconnecting mid-decode frees its KV slot
+    and leaves the other tenant's output untouched."""
+    g, params, door = decode_door
+    host, port = door.address
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 97, (4,)).astype(np.int32)
+               for _ in range(2)]
+    solo = ServeClient(host, port, "ref",
+                       max_new_tokens=4).stream([prompts[0]])
+    assert solo[0][0] == "ok"
+
+    # victim starts a long generation then disconnects without END
+    victim = ServeClient(host, port, "victim", max_new_tokens=40)
+    victim.submit(prompts[1])
+    deadline = time.monotonic() + 30
+    while door.engine.active() == 0:
+        assert time.monotonic() < deadline, "victim never joined"
+        time.sleep(0.01)
+    victim.abort()
+
+    steady = ServeClient(host, port, "steady", max_new_tokens=4)
+    out = steady.stream([prompts[0]])
+    assert out[0][0] == "ok"
+    np.testing.assert_array_equal(out[0][1], solo[0][1])
+
+    deadline = time.monotonic() + 30
+    while door.engine.free_slots() != door.engine.width:
+        assert time.monotonic() < deadline, \
+            "the disconnected client's KV slot was never reclaimed"
+        time.sleep(0.05)
+    door.healthcheck()
+
+
+# ---------------------------------------------------------------------------
+# the CLI surface (in-process: serve + serve-client + monitor --serve)
+# ---------------------------------------------------------------------------
+
+def test_cli_serve_serve_client_and_monitor(capsys):
+    from defer_tpu import cli
+    from defer_tpu.runtime.node import _free_ports
+
+    port = _free_ports(1)[0]
+    addr = f"127.0.0.1:{port}"
+    t = threading.Thread(
+        target=cli.main,
+        args=(["serve", "--model", "resnet_tiny", "--stages", "2",
+               "--width", "2", "--listen", addr, "--seconds", "6",
+               "--tenant", "gold=2.0:1:5000"],),
+        daemon=True)
+    t.start()
+    # the load-generating client CLI against the booting door
+    cli.main(["serve-client", "--connect", addr, "--tenant", "gold",
+              "--rate", "30", "--seconds", "1", "--seed", "3",
+              "--burst", "0.2:0.6:2.0"])
+    # the monitor's per-tenant serve columns
+    cli.main(["monitor", "--serve", addr, "--iterations", "1",
+              "--interval-ms", "50", "--json"])
+    # the serve thread must finish INSIDE this test: a stray print
+    # after --seconds elapse would land in some other test's capture
+    t.join(timeout=60)
+    assert not t.is_alive()
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    gen = next(json.loads(ln) for ln in lines
+               if "latency_p99_ms" in ln)
+    assert gen["tenant"] == "gold" and gen["completed"] >= 1
+    assert gen["shed"] == 0, "a 5s SLO at 30 Hz must not shed"
+    mon = next(json.loads(ln) for ln in lines if '"serve"' in ln)
+    assert mon["serve"]["mode"] == "tensor"
+    assert mon["serve"]["tenants"]["gold"]["weight"] == 2.0
+    assert mon["serve"]["tenants"]["gold"]["completed"] \
+        == gen["completed"]
