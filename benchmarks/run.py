@@ -292,6 +292,14 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: deterministic OPEN-LOOP Poisson arrival trace — closed-loop load
 #: hides queueing delay, so the p99 here is measured against arrivals
 #: fixed up front; `--arrival-seed` reseeds the trace)
+#: ... and `dag_pipeline` (branch-parallel stage graphs: the two-branch
+#: delay-bound inception_tiny region deployed as concurrent sub-
+#: pipelines between a broadcast fork and an all-paths (path, seq)
+#: join, byte-identical to the serial composition of its own stage
+#: programs and >= 1.5x min-of-3 wall vs the best linear-cut chain at
+#: the SAME node count; the row also records the critical-path
+#: planner's predicted DAG-vs-linear bottlenecks on inception_tiny and
+#: the branched MoE family — docs/PLANNER.md)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
@@ -299,6 +307,7 @@ SCRIPT_ROWS = {
     "obs_overhead": "monitor_smoke.py",
     "colocated_fastpath": "colocate_smoke.py",
     "serving_frontdoor": "serve_smoke.py",
+    "dag_pipeline": "dag_smoke.py",
 }
 
 

@@ -202,6 +202,14 @@ def cmd_partition(args):
                          "explicit cuts leave nothing to balance")
     if cuts is None and args.balance != "flops" and args.stages is None:
         raise SystemExit(f"--balance {args.balance} requires --stages")
+    if cuts is None and args.stages is not None:
+        # branching graphs lock most nodes inside their merge regions:
+        # name the offending merge nodes (and point at plan --dag)
+        # instead of dying deep in the cut search
+        from .graph.analysis import linear_cut_shortage
+        shortage = linear_cut_shortage(graph, args.stages)
+        if shortage:
+            raise SystemExit(f"partition: {shortage}")
     plan = None
     if cuts is None and args.balance == "measured":
         # latency-balanced auto-cuts: time every op on THIS backend and
@@ -259,11 +267,68 @@ def cmd_partition(args):
     del jax  # imported for backend side effects only
 
 
+def _linear_critical_path_s(plan) -> float:
+    """Per-sample latency of a chain plan: the sum of per-stage
+    ``max(compute, comm)`` — a chain's stage graph IS one path."""
+    comm = plan.hop_comm_s + [0.0]
+    return sum(max(c, h) for c, h in zip(plan.stage_compute_s, comm))
+
+
+def _cmd_plan_dag(args, graph, cm, doc: dict, *,
+                  hop_tiers: dict | None) -> None:
+    """``plan --dag``: branch-parallel stage graph vs the best linear
+    chain at the same process budget (docs/PLANNER.md)."""
+    from .plan.dag import best_linear_plan, solve_dag
+    num_nodes = args.nodes or args.stages
+    if not num_nodes:
+        raise SystemExit("plan --dag requires --nodes N (process "
+                         "budget; --stages N also works)")
+    dag = solve_dag(graph, cm, num_nodes=num_nodes, hop_tiers=hop_tiers)
+    linear = best_linear_plan(graph, cm, num_nodes)
+    lin_cp = _linear_critical_path_s(linear)
+    doc["plan"] = dag.to_json()
+    doc["linear"] = linear.to_json()
+    doc["linear"]["critical_path_ms"] = round(lin_cp * 1e3, 6)
+    doc["predicted_speedup_vs_linear"] = round(
+        linear.bottleneck_s / dag.bottleneck_s, 4) \
+        if dag.bottleneck_s > 0 else None
+    doc["predicted_latency_speedup_vs_linear"] = round(
+        lin_cp / dag.critical_path_s, 4) \
+        if dag.critical_path_s > 0 else None
+    if args.json:
+        print(json.dumps(doc))
+        return
+    print(f"{graph.name}: DAG plan, {dag.num_stages} stage vertices / "
+          f"{num_nodes} node budget, cost model "
+          f"{cm.describe()['node_costs']}")
+    for v in dag.vertices:
+        mark = " <- bottleneck" if v.vid == dag.bottleneck_vertex else ""
+        role = ""
+        if v.fan == "broadcast":
+            role = f" fork x{len(v.next)}"
+        if v.join >= 2:
+            role += f" join x{v.join}"
+        print(f"  {v.label:>11}: compute {v.compute_s * 1e3:10.4f} ms | "
+              f"hop {v.comm_s * 1e3:10.4f} ms ({v.codec})"
+              f"{role}{mark}")
+    print(f"  parallel regions: "
+          + (", ".join(f"{r['fork']}->{r['join']} x{r['paths']}"
+                       for r in dag.parallel_regions) or "none "
+             "(linear chain is optimal at this budget)"))
+    print(f"  predicted bottleneck {dag.bottleneck_s * 1e3:.4f} ms, "
+          f"critical path {dag.critical_path_s * 1e3:.4f} ms")
+    print(f"  linear baseline ({linear.num_stages} stages): bottleneck "
+          f"{linear.bottleneck_s * 1e3:.4f} ms, critical path "
+          f"{lin_cp * 1e3:.4f} ms (speedup "
+          f"{doc['predicted_speedup_vs_linear']}x throughput, "
+          f"{doc['predicted_latency_speedup_vs_linear']}x latency)")
+
+
 def cmd_plan(args):
     """Comm-aware bottleneck plan: solve, score the quantile baseline on
     the same cost model, optionally sweep stage counts / replan from a
     telemetry snapshot (docs/PLANNER.md)."""
-    from .graph.analysis import auto_cut_points
+    from .graph.analysis import auto_cut_points, linear_cut_shortage
     from .plan import evaluate_cuts, solve, sweep_stages
 
     graph = _get_model(args.model)
@@ -274,8 +339,25 @@ def cmd_plan(args):
         from .utils.profiling import measured_node_costs
         params = graph.init(jax.random.key(0))
         node_costs = measured_node_costs(graph, params, batch=args.batch)
+    dag_tiers = None
+    if args.dag:
+        # the DAG planner validates hop-tier keys against the stage-
+        # GRAPH cut namespace (branch-internal hops included) — keep
+        # them away from the cost-model constructor's linear check
+        dag_tiers = _parse_hop_tier_map(getattr(args, "hop_tier_map", ""))
+        args.hop_tier_map = ""
     cm = _cost_model(args, graph, node_costs=node_costs)
     doc: dict = {"model": graph.name, "cost_model": cm.describe()}
+    if args.dag:
+        _cmd_plan_dag(args, graph, cm, doc, hop_tiers=dag_tiers)
+        return
+    if args.stages is not None and not args.nodes and not args.sweep:
+        # pre-validate BEFORE the DP: an oversubscribed stage count on a
+        # branching graph must name the merge nodes locking the cuts
+        # (and point at --dag), not die deep in the solver
+        shortage = linear_cut_shortage(graph, args.stages)
+        if shortage:
+            raise SystemExit(f"plan: {shortage}")
     if args.nodes:
         # hybrid pipeline/data-parallel: joint cuts + replica counts for
         # a process budget, vs the best cuts-only plan it must beat
@@ -517,22 +599,32 @@ def cmd_node(args):
     _codec(args.codec)  # loud at boot, not when the first tensor relays
 
     def boot(artifact, listen, nxt, codec, tier, accept, primary):
-        # --fan-in/--replica describe the PRIMARY node's place in a fan
-        # topology; housemates always sit on plain local hops (the fan
-        # machinery is wire-framed, and colocation next to replication
-        # is rejected upstream), so they never inherit either flag
+        # --fan-in/--replica (and the branch-graph roles --fan/--branch/
+        # --join) describe the PRIMARY node's place in a fan topology;
+        # housemates always sit on plain local hops (the fan machinery
+        # is wire-framed, and colocation next to replication is
+        # rejected upstream), so they never inherit any of them
         node = StageNode(artifact, listen, nxt,
                          codec=codec, overlap=not args.no_overlap,
                          rx_depth=args.rx_depth, tx_depth=args.tx_depth,
                          inflight=args.inflight,
                          fan_in=args.fan_in if primary else 1,
                          replica=args.replica if primary else None,
+                         fan_mode=args.fan if primary else "rr",
+                         branch=args.branch if primary else None,
+                         join_in=args.join if primary else 0,
+                         infer_delay_s=args.infer_delay_ms / 1e3
+                         if primary else 0.0,
                          tier=tier, tier_accept=accept)
         what = (f"stage {node.manifest['index']} "
                 f"({node.manifest['name']})"
                 if node.manifest else "EMPTY (awaiting in-band deploy)")
         if node.replica is not None:
             what += f" replica {node.replica}"
+        if node.branch is not None:
+            what += f" branch {node.branch}"
+        if node.join_in >= 2:
+            what += f" join {node.join_in}"
         if node.fan_in > 1:
             what += f" fan-in {node.fan_in}"
         print(f"node: {what} listening on "
@@ -600,6 +692,90 @@ def _parse_replicas(spec: str) -> dict[int, int]:
     return out
 
 
+def _chain_inputs(in_spec, batch: int, count: int) -> list:
+    """Deterministic input frames matching the entry boundary's spec
+    (integer specs get token ids — the MoE/GPT families embed them)."""
+    rng = np.random.default_rng(0)
+    if np.issubdtype(np.dtype(in_spec.dtype), np.integer):
+        return [rng.integers(0, 100, (batch,) + in_spec.shape)
+                .astype(in_spec.dtype) for _ in range(count)]
+    return [rng.standard_normal((batch,) + in_spec.shape)
+            .astype(np.float32) for _ in range(count)]
+
+
+def _cmd_chain_dag(args, graph, params) -> None:
+    """``chain --dag`` / ``chain --topology FILE``: deploy the branch-
+    parallel stage graph — one OS process per topology vertex, parallel
+    branches concurrent between a broadcast fork and an all-paths join
+    (docs/TRANSPORT.md)."""
+    import jax
+
+    from .runtime.node import run_dag_chain
+    from .runtime.topology import ChainTopology
+
+    if args.replicas:
+        raise SystemExit(
+            "chain --dag: replicas do not compose with a branched "
+            "topology (a branch hop touching a replicated stage is "
+            "rejected like any fan hop); drop --replicas")
+    if args.hop_tiers:
+        raise SystemExit(
+            "chain --dag: hop tiers do not compose with a branched "
+            "topology — every branch fan-out/join hop is wire-framed "
+            "by design")
+    if args.cuts:
+        raise SystemExit(
+            "chain --dag: --cuts is the linear planner's input; the "
+            "DAG topology comes from the solver (or --topology FILE)")
+    dag_doc = None
+    if args.topology:
+        with open(args.topology) as f:
+            topo = ChainTopology.from_json(json.load(f))
+    else:
+        from .plan import StageCostModel
+        from .plan.dag import solve_dag
+        dag = solve_dag(graph, StageCostModel(graph, batch=args.batch),
+                        num_nodes=args.nodes or args.stages)
+        dag_doc = dag.to_json()
+        topo = ChainTopology.from_json(dag.topology_json())
+    from .graph.analysis import max_activation_bytes
+    _apply_sock_buf(args, auto_bytes=max_activation_bytes(
+        graph, [v.output for v in topo.vertices[:-1]
+                if v.output in graph.nodes], batch=args.batch))
+    in_spec = graph.out_spec(topo.entry.inputs[0])
+    xs = _chain_inputs(in_spec, args.batch, args.count)
+    _start_prom(args, "chain")
+    stats: list = []
+    t0 = time.perf_counter()
+    outs = run_dag_chain(graph, params, xs, topology=topo,
+                         batch=args.batch, codec=args.codec,
+                         rx_depth=args.rx_depth, tx_depth=args.tx_depth,
+                         inflight=args.inflight, stats_out=stats,
+                         trace_sample_every=args.trace_sample)
+    dt = time.perf_counter() - t0
+    fwd = jax.jit(graph.apply)
+    worst = max(float(np.abs(np.asarray(fwd(params, x)) - y).max())
+                for x, y in zip(xs, outs))
+    row = {
+        "metric": f"{args.model}_{len(topo)}proc_dag_chain",
+        "value": round(len(xs) * args.batch / dt, 3),
+        "unit": "inferences/sec",
+        "stages": len(topo),
+        "labels": [v.label for v in topo.vertices],
+        "forks": sum(1 for v in topo.vertices if v.fan == "broadcast"),
+        "joins": sum(1 for v in topo.vertices if v.join >= 2),
+        "codec": args.codec,
+        "overlap": not args.no_overlap,
+        "max_abs_err_vs_single_program": worst,
+    }
+    if dag_doc is not None:
+        row["predicted_bottleneck_ms"] = dag_doc["bottleneck_ms"]
+        row["predicted_critical_path_ms"] = dag_doc["critical_path_ms"]
+        row["parallel_regions"] = dag_doc["parallel_regions"]
+    print(json.dumps(row))
+    _obs_finish(args)
+
+
 def cmd_chain(args):
     import jax
 
@@ -609,10 +785,21 @@ def cmd_chain(args):
     _obs_begin(args)
     graph = _get_model(args.model)
     params = graph.init(jax.random.key(0))
+    if args.dag or args.topology:
+        _cmd_chain_dag(args, graph, params)
+        return
     cuts = args.cuts.split(",") if args.cuts else None
     if cuts is not None and args.balance != "flops":
         raise SystemExit(f"--cuts and --balance {args.balance} conflict: "
                          "explicit cuts leave nothing to balance")
+    if cuts is None and args.stages:
+        # same pre-validation as plan/partition: name the merge nodes
+        # locking the cuts instead of dying deep in the cut search
+        from .graph.analysis import linear_cut_shortage
+        shortage = linear_cut_shortage(graph, args.stages)
+        if shortage:
+            raise SystemExit(
+                f"chain: {shortage.replace('plan --dag', 'chain --dag')}")
     stages = partition(graph, cuts, num_stages=args.stages,
                        objective="bottleneck"
                        if cuts is None and args.balance == "bottleneck"
@@ -686,16 +873,26 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
     tty = sys.stdout.isatty()
     if clear and tty:
         print("\x1b[2J\x1b[H", end="")
-    print(f"{'STAGE':>5} {'REP':>3} {'TIER':>5} {'INF/S':>8} {'P50MS':>9} "
+    print(f"{'STAGE':>5} {'BR':>3} {'REP':>3} {'TIER':>5} {'INF/S':>8} "
+          f"{'P50MS':>9} "
           f"{'P95MS':>9} {'P99MS':>9} {'RXQ':>4} {'TXQ':>4} "
           f"{'RX^':>4} {'TX^':>4} {'INF':>4} {'RX B/S':>11} "
           f"{'TX B/S':>11} {'DONE':>8}  ADDR")
     for r in rows:
         stage = "-" if r["stage"] is None else str(r["stage"])
         rep = "-" if r["replica"] is None else str(r["replica"])
+        # branched topologies: bJ = this row rides branch path J of a
+        # fork/join region, jP = this row is the P-path join — so the
+        # bottleneck highlight names a branch, not a flattened index
+        if r.get("branch") is not None:
+            br = f"b{r['branch']}"
+        elif (r.get("join") or 0) >= 2:
+            br = f"j{r['join']}"
+        else:
+            br = "-"
         tier = (r.get("tier") or "-")[:5]
         p = r["infer_ms"]
-        line = (f"{stage:>5} {rep:>3} {tier:>5} "
+        line = (f"{stage:>5} {br:>3} {rep:>3} {tier:>5} "
                 f"{r['throughput_per_s']:>8.1f} "
                 f"{p['p50']:>9.3f} {p['p95']:>9.3f} {p['p99']:>9.3f} "
                 f"{r['rx_q']:>4.0f} {r['tx_q']:>4.0f} "
@@ -1142,6 +1339,14 @@ def main(argv=None):
                     help="re-solve with measured per-stage seconds from "
                          "a --metrics-out snapshot (telemetry-corrected "
                          "cost model)")
+    pl.add_argument("--dag", action="store_true",
+                    help="branch-parallel stage GRAPH plan for --nodes N "
+                         "processes: parallel branches become concurrent "
+                         "sub-pipelines with a broadcast fork and an "
+                         "all-paths join; reports bottleneck AND "
+                         "critical path vs the best linear plan at the "
+                         "same node count, and the JSON carries the "
+                         "deployable topology (docs/PLANNER.md)")
     pl.add_argument("--json", action="store_true")
     _add_cost_flags(pl)
 
@@ -1186,6 +1391,25 @@ def main(argv=None):
     nd.add_argument("--replica", type=int, default=None, metavar="N",
                     help="this process is replica N of its stage "
                          "(labels stageK.rN spans/stats)")
+    nd.add_argument("--fan", choices=["rr", "broadcast"], default="rr",
+                    help="multi-hop --next distribution: rr round-robins "
+                         "across stage replicas; broadcast sends EVERY "
+                         "frame to every hop (the fork of a branched "
+                         "stage graph, one shared seq stamp per frame)")
+    nd.add_argument("--branch", type=int, default=None, metavar="J",
+                    help="this node rides branch path J of a fork/join "
+                         "region (labels stageK.bJ spans/stats; the "
+                         "outbound stream announces path J to the join)")
+    nd.add_argument("--join", type=int, default=0, metavar="P",
+                    help="this node is the region's JOIN: merge P "
+                         "labeled branch paths per sequence through a "
+                         "(path, seq) reorder buffer and run the "
+                         "multi-input merge program")
+    nd.add_argument("--infer-delay-ms", type=float, default=0.0,
+                    help="bench-only: sleep this long per frame in the "
+                         "compute loop (simulated accelerator time — "
+                         "how the DAG smoke expresses branch compute "
+                         "on a 1-core host)")
     nd.add_argument("--prom-port", type=int, default=None, metavar="PORT",
                     help="serve this process's metrics registry as a "
                          "Prometheus scrape endpoint on PORT "
@@ -1247,6 +1471,18 @@ def main(argv=None):
                         "two stages into one jit program, local "
                         "COLOCATES them in one OS process with an "
                         "in-memory channel between them")
+    c.add_argument("--dag", action="store_true",
+                   help="deploy the DAG planner's branch-parallel stage "
+                        "GRAPH instead of a linear chain: parallel "
+                        "branches run as concurrent processes between a "
+                        "broadcast fork and an all-paths join "
+                        "(--nodes sets the process budget; replicas / "
+                        "hop tiers do not compose with branch fans)")
+    c.add_argument("--nodes", type=int, default=0, metavar="N",
+                   help="--dag process budget (default: --stages)")
+    c.add_argument("--topology", default=None, metavar="FILE",
+                   help="deploy an explicit topology JSON (a `plan "
+                        "--dag --json` document) instead of solving")
     _add_overlap_flags(c)
     _add_obs_flags(c)
 

@@ -171,13 +171,14 @@ class LayerGraph:
     def apply(
         self,
         params: dict[str, Params],
-        x: jax.Array,
+        x: jax.Array = None,
         *,
         upto: str | None = None,
         start: str | None = None,
         node_names: Sequence[str] | None = None,
         tp_axis: str | None = None,
         tp: int = 1,
+        seeds: dict[str, jax.Array] | None = None,
     ) -> jax.Array:
         """Memoized forward pass over (a sub-range of) the graph.
 
@@ -187,13 +188,22 @@ class LayerGraph:
         the reference's ``construct_model(model, start, end)``
         (src/dag_util.py:27-31) without rebuilding any graph.
 
+        ``seeds`` (name -> array) seeds the cache with SEVERAL boundary
+        tensors instead of one ``start`` — how a join stage of a
+        branched pipeline resumes evaluation from all of its merge op's
+        inputs at once (``partition.stage.JoinStageSpec``).
+
         With ``tp_axis`` set (inside ``shard_map`` over a "model" mesh
         axis), each op runs its tensor-parallel path on TP-sharded params
         (see ``parallel/tensor.py``).
         """
+        if x is None and seeds is None:
+            raise TypeError("apply() needs an input array x (or seeds= "
+                            "boundary tensors)")
         start = start or self.input_name
         upto = upto or self.output_name
-        cache: dict[str, jax.Array] = {start: x}
+        cache: dict[str, jax.Array] = (
+            dict(seeds) if seeds is not None else {start: x})
         names = node_names if node_names is not None else self.topo_order
         for name in names:
             if name in cache:  # the seeded start node

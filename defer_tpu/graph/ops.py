@@ -731,3 +731,64 @@ class MoE(Op):
         t, d = spec.shape
         # effective top-1 cost: one expert per token
         return 2 * t * d * (2 * self.hidden) + 2 * t * d * self.num_experts
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class ExpertBranch(Op):
+    """One expert's BRANCH of a branched mixture-of-experts layer.
+
+    Where :class:`MoE` evaluates every expert inside one op (and the
+    expert-parallel path shards them over a mesh axis,
+    ``parallel/expert.py``), the branched formulation puts each expert
+    on its own GRAPH branch so the DAG pipeline can place it on its own
+    node: every branch reads the full block output (the fork tensor),
+    computes its own softmax gate weight and expert FFN, and emits
+    ``probs[..., expert] * ffn_e(x)``; the region's join is a plain
+    :class:`Add` over the residual skip and all expert branches, so the
+    merged output is the SOFT mixture ``x + sum_e p_e(x) * ffn_e(x)``.
+
+    Soft (dense) gating on purpose: each branch re-derives its gate
+    weight from its own replicated gate matrix, so branches stay
+    self-contained single-input ops — a shared top-1 router would need a
+    second tensor crossing the fork, which the single-tensor-cut
+    transport does not carry.  Per-branch cost is one expert's FFN, the
+    quantity expert-parallel placement divides.
+    """
+
+    num_experts: int
+    expert: int
+    hidden: int
+
+    def init(self, key, in_specs):
+        (spec,) = in_specs
+        d = spec.shape[-1]
+        ks = jax.random.split(key, 3)
+        return {
+            # the gate is replicated per branch and seeded by the
+            # branch's OWN init key: gate weights differ across branches
+            # by construction, which is fine for the soft mixture (each
+            # branch's scalar weight is its own function of x)
+            "gate": jax.random.normal(ks[0], (d, self.num_experts),
+                                      jnp.float32) * 0.02,
+            "fc1": {"w": jax.random.normal(ks[1], (d, self.hidden),
+                                           jnp.float32) / math.sqrt(d),
+                    "b": jnp.zeros((self.hidden,), jnp.float32)},
+            "fc2": {"w": jax.random.normal(ks[2], (self.hidden, d),
+                                           jnp.float32)
+                    / math.sqrt(self.hidden),
+                    "b": jnp.zeros((d,), jnp.float32)},
+        }
+
+    def apply(self, params, x):
+        logits = x @ params["gate"].astype(x.dtype)
+        pe = jax.nn.softmax(logits, axis=-1)[..., self.expert]
+        h = jax.nn.gelu(x @ params["fc1"]["w"].astype(x.dtype)
+                        + params["fc1"]["b"].astype(x.dtype))
+        y = h @ params["fc2"]["w"].astype(x.dtype) \
+            + params["fc2"]["b"].astype(x.dtype)
+        return y * pe[..., None]
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        t, d = spec.shape
+        return 2 * t * d * (2 * self.hidden) + 2 * t * d * self.num_experts

@@ -11,7 +11,8 @@ Each family ships a ``*_tiny`` variant for fast CPU-mesh tests.
 
 from .bert import BERT_BASE_12STAGE_CUTS, bert, bert_base, bert_tiny
 from .gpt import gpt, gpt2_small, gpt_small, gpt_stage_cuts, gpt_tiny
-from .moe import moe_stage_cuts, moe_tiny, moe_transformer
+from .moe import (moe_branched, moe_branched_tiny, moe_stage_cuts,
+                  moe_tiny, moe_transformer)
 from .inception import (INCEPTION_6STAGE_CUTS, inception, inception_tiny,
                         inception_v3)
 from .mobilenet import (MOBILENETV2_2STAGE_CUTS, mobilenet_tiny, mobilenet_v2)
@@ -26,4 +27,5 @@ __all__ = [
     "bert", "bert_base", "bert_tiny", "BERT_BASE_12STAGE_CUTS",
     "gpt", "gpt2_small", "gpt_small", "gpt_tiny", "gpt_stage_cuts",
     "moe_transformer", "moe_tiny", "moe_stage_cuts",
+    "moe_branched", "moe_branched_tiny",
 ]

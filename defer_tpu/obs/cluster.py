@@ -296,6 +296,12 @@ class ClusterView:
             row = {
                 "stage": node.ident.get("stage"),
                 "replica": node.ident.get("replica"),
+                # branched stage graphs (docs/TRANSPORT.md): the branch
+                # path this vertex rides, and the join width when this
+                # vertex merges P paths — what the monitor's BR column
+                # renders so a bottleneck highlight names the branch
+                "branch": node.ident.get("branch"),
+                "join": node.ident.get("join"),
                 "name": node.ident.get("name"),
                 # negotiated OUTBOUND transport tier of the node's hop
                 # (tcp / local / auto-until-negotiated) — distinguishes

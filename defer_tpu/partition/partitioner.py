@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from ..graph.analysis import auto_cut_points, valid_cut_points
 from ..graph.ir import LayerGraph
-from .stage import StageSpec
+from .stage import JoinStageSpec, StageSpec
 
 
 def partition(graph: LayerGraph, cut_points: list[str] | None = None,
@@ -77,6 +77,51 @@ def partition(graph: LayerGraph, cut_points: list[str] | None = None,
             out_spec=graph.out_spec(end),
         ))
     return stages
+
+
+def stage_specs_for_vertices(graph: LayerGraph, vertices) -> list:
+    """One stage spec per :class:`~defer_tpu.runtime.topology.TopoVertex`
+    — the DAG partitioner.
+
+    Where :func:`partition` slices the graph at a linear cut list, a
+    topology names each vertex's node slice explicitly (branch bodies
+    are not contiguous in the full graph's topo order), so this is a
+    checked projection, not a search: every vertex becomes a
+    :class:`StageSpec` (or :class:`JoinStageSpec` when it merges P
+    paths), validated to evaluate a well-formed closure — every node's
+    inputs must come from the vertex's own slice or its seed tensors.
+    """
+    order = {n: i for i, n in enumerate(graph.topo_order)}
+    specs = []
+    for v in vertices:
+        have = set(v.inputs) | set(v.nodes)
+        for n in v.nodes:
+            if n not in graph.nodes:
+                raise ValueError(f"vertex {v.vid}: unknown node {n!r}")
+            missing = [i for i in graph.nodes[n].inputs if i not in have]
+            if missing:
+                raise ValueError(
+                    f"vertex {v.vid}: node {n!r} needs {missing} which "
+                    f"neither the vertex slice nor its seed inputs "
+                    f"{list(v.inputs)} provide")
+        nodes = tuple(sorted(v.nodes, key=order.__getitem__))
+        if not nodes or nodes[-1] != v.output:
+            raise ValueError(f"vertex {v.vid}: output {v.output!r} must "
+                             f"be the slice's final node")
+        name = f"{graph.name}/{v.label}"
+        if v.join >= 2:
+            specs.append(JoinStageSpec(
+                index=v.vid, name=name, graph=graph, node_names=nodes,
+                input_names=tuple(v.inputs), output_name=v.output,
+                in_specs=tuple(graph.out_spec(i) for i in v.inputs),
+                out_spec=graph.out_spec(v.output)))
+        else:
+            specs.append(StageSpec(
+                index=v.vid, name=name, graph=graph, node_names=nodes,
+                input_name=v.inputs[0], output_name=v.output,
+                in_spec=graph.out_spec(v.inputs[0]),
+                out_spec=graph.out_spec(v.output)))
+    return specs
 
 
 def fuse_stages(stages: "list[StageSpec]", hop_tiers: "list[str]"

@@ -24,6 +24,8 @@ from .cost import (CodecSpec, DEFAULT_CODECS, TIER_CODECS, StageCostModel,
                    bench_codec_instance, bench_codec_spec,
                    calibrate_codecs, max_batch_within_budget,
                    stage_ms_at_batch)
+from .dag import (DagPlan, best_linear_plan, brute_force_dag,
+                  dag_plan_from_json, solve_dag)
 from .replan import (ReplanResult, corrected_cost_model,
                      cost_model_from_plan, measured_stage_seconds, replan)
 from .solver import (Plan, ReplicatedPlan, brute_force,
@@ -37,6 +39,8 @@ __all__ = [
     "Plan", "solve", "evaluate_cuts", "sweep_stages", "brute_force",
     "ReplicatedPlan", "solve_replicated", "brute_force_replicated",
     "sweep_nodes", "plan_from_json",
+    "DagPlan", "solve_dag", "brute_force_dag", "dag_plan_from_json",
+    "best_linear_plan",
     "ReplanResult", "replan", "measured_stage_seconds",
     "corrected_cost_model", "cost_model_from_plan",
     "max_batch_within_budget", "stage_ms_at_batch",

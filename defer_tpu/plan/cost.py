@@ -111,19 +111,27 @@ DEFAULT_LOCAL_BW_S = 1e10
 
 
 def _check_hop_tiers(graph: LayerGraph,
-                     hop_tiers: dict[str, str] | None) -> dict[str, str]:
+                     hop_tiers: dict[str, str] | None, *,
+                     valid=None) -> dict[str, str]:
     """Validate a hop-tier map: known tier names AND real cut-point
     keys — a misspelled cut silently scoring as tcp would make the
     planner model a topology the caller never declared (same loud-miss
-    policy as the constructor's ``node_costs`` check)."""
+    policy as the constructor's ``node_costs`` check).
+
+    ``valid`` overrides the cut namespace: the DAG planner passes
+    ``graph.analysis.dag_cut_points`` so branch-internal hops — real
+    deployable boundaries once branches run as their own sub-pipelines
+    — validate too, under the same loud-miss policy."""
     if not hop_tiers:
         return {}
     bad = [t for t in hop_tiers.values() if t not in ("tcp", *TIER_CODECS)]
     if bad:
         raise ValueError(f"unknown hop tiers {bad}; "
                          f"use tcp|{'|'.join(TIER_CODECS)}")
-    from ..graph.analysis import valid_cut_points
-    valid = set(valid_cut_points(graph))
+    if valid is None:
+        from ..graph.analysis import valid_cut_points
+        valid = valid_cut_points(graph)
+    valid = set(valid)
     missing = [c for c in hop_tiers if c not in valid]
     if missing:
         raise ValueError(
@@ -286,13 +294,16 @@ class StageCostModel:
         """Declared transport tier of the hop at ``cut`` (default tcp)."""
         return self.hop_tiers.get(cut, "tcp")
 
-    def with_hop_tiers(self, hop_tiers: dict[str, str] | None
-                       ) -> "StageCostModel":
+    def with_hop_tiers(self, hop_tiers: dict[str, str] | None, *,
+                       valid_cuts=None) -> "StageCostModel":
         """A shallow copy scoring hops under ``hop_tiers`` — how
         ``solve(..., hop_tiers=...)`` threads a deployment's tier map
-        through without mutating the caller's model."""
+        through without mutating the caller's model.  ``valid_cuts``
+        widens the key namespace (the DAG planner passes the stage-graph
+        cut set, branch-internal hops included)."""
         other = copy.copy(self)
-        other.hop_tiers = _check_hop_tiers(self.graph, hop_tiers)
+        other.hop_tiers = _check_hop_tiers(self.graph, hop_tiers,
+                                           valid=valid_cuts)
         return other
 
     def _tier_parts(self, cut: str, tier: str

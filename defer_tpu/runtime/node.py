@@ -45,6 +45,7 @@ from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
                                 K_TENSOR_SEQ, configure_socket,
                                 connect_retry, recv_expect, recv_frame,
                                 send_ack, send_ctrl, send_end, send_frame)
+from ..transport.branch import BranchJoin, BroadcastSender
 from ..transport.replicate import FanInMerge, FanOutSender
 
 
@@ -103,6 +104,23 @@ class StageNode:
     inflight: int = 2
     fan_in: int = 1
     replica: int | None = None
+    #: branched stage graphs (docs/TRANSPORT.md): ``fan_mode="broadcast"``
+    #: sends every frame to EVERY downstream hop (parallel branches all
+    #: read the fork tensor) instead of round-robin replica fan-out;
+    #: ``branch`` labels this node's path through a fork/join region
+    #: (spans/stats become ``stageK.bJ``, and the outbound stream_begin
+    #: carries the path so the join can slot this connection); ``join_in
+    #: >= 2`` makes this node the region's join — P labeled upstream
+    #: connections merge through a (path, seq) reorder buffer and the
+    #: multi-input stage program runs on all P parts per sequence
+    fan_mode: str = "rr"
+    branch: int | None = None
+    join_in: int = 0
+    #: bench-only simulated accelerator seconds per frame (serialized in
+    #: the compute loop, sleeping — not spinning — so concurrent stage
+    #: processes on a small host still overlap like real devices would;
+    #: how the DAG smoke makes branch compute delay-bound on 1 core)
+    infer_delay_s: float = 0.0
     next_hops: list[tuple[str, int]] | None = None
     #: outbound transport-tier policy (docs/TRANSPORT.md): "auto" offers
     #: the colocated fast path on the downstream dial (a tier_probe
@@ -123,6 +141,9 @@ class StageNode:
     #: stream) — what obs_push reads queue depths/watermarks from
     _live_rx = None
     _live_tx = None
+    #: branch-join reorder buffer (class default covers ``__new__``-
+    #: built test stubs)
+    _join: BranchJoin | None = None
     #: per-NODE infer histogram (None on ``__new__``-built stubs): the
     #: registry's ``node.infer_s`` is process-wide, which in-process
     #: thread chains share across nodes — this instance copy keeps
@@ -137,6 +158,8 @@ class StageNode:
                  overlap: bool = True, rx_depth: int = 8,
                  tx_depth: int = 8, inflight: int = 2,
                  fan_in: int = 1, replica: int | None = None,
+                 fan_mode: str = "rr", branch: int | None = None,
+                 join_in: int = 0, infer_delay_s: float = 0.0,
                  tier: str = "tcp", tier_accept: bool = True):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
@@ -156,6 +179,20 @@ class StageNode:
         self.inflight = max(1, inflight)
         self.fan_in = max(1, fan_in)
         self.replica = replica
+        if fan_mode not in ("rr", "broadcast"):
+            raise ValueError(f"fan_mode must be rr|broadcast, "
+                             f"got {fan_mode!r}")
+        self.fan_mode = fan_mode
+        self.branch = None if branch is None else int(branch)
+        self.join_in = max(0, int(join_in))
+        if self.join_in == 1:
+            raise ValueError("join_in must be 0 or >= 2 (a single-path "
+                             "join is a plain unicast hop)")
+        if self.join_in >= 2 and self.fan_in > 1:
+            raise ValueError("a node cannot be both a branch join and a "
+                             "replica fan-in (the two merges own "
+                             "different sequence namespaces)")
+        self.infer_delay_s = max(0.0, float(infer_delay_s))
         if tier not in ("tcp", "auto"):
             raise ValueError(f"tier must be tcp|auto, got {tier!r}")
         self.tier = tier
@@ -172,6 +209,9 @@ class StageNode:
         #: connections and the single compute loop (lazy, lock-guarded)
         self._merge: FanInMerge | None = None
         self._merge_lock = threading.Lock()
+        #: branch-join state: the (path, seq) reorder buffer shared by
+        #: the P labeled upstream readers and one compute loop
+        self._join: BranchJoin | None = None
         self._done_q = None   # serve()'s completion queue (set per serve)
         self._live_rx = None
         self._live_tx = None
@@ -195,12 +235,17 @@ class StageNode:
 
     def _span_label(self) -> str:
         """Span/track prefix for this node's rx/tx/infer telemetry;
-        replicas get a ``stageK.rN`` prefix so traces show the
-        interleave across the parallel paths."""
+        replicas get a ``stageK.rN`` prefix and branch-path nodes a
+        ``stageK.bJ`` one, so traces/stats show which parallel path a
+        row belongs to instead of a flattened index."""
         m = self.manifest
         base = (f"stage{m['index']}" if m is not None
                 else f"node{self.address[1]}")
-        return base if self.replica is None else f"{base}.r{self.replica}"
+        if self.replica is not None:
+            return f"{base}.r{self.replica}"
+        if self.branch is not None:
+            return f"{base}.b{self.branch}"
+        return base
 
     def _make_tx(self, connect_timeout_s: float):
         """Open the downstream connection(s): one :class:`AsyncSender`,
@@ -222,7 +267,10 @@ class StageNode:
                  for h in self.next_hops]
         if len(socks) == 1:
             tx = None
-            if self.tier == "auto" and self.replica is None:
+            if self.tier == "auto" and self.replica is None \
+                    and self.branch is None:
+                # branch-path hops never probe: the join end is wire-
+                # framed by design (ordered (path, seq) merge)
                 from ..transport.local import offer_local
                 self.tier_out, pipe = offer_local(socks[0],
                                                   depth=self.tx_depth)
@@ -231,6 +279,22 @@ class StageNode:
             if tx is None:
                 self.tier_out = "tcp"
                 tx = AsyncSender(socks[0], depth=self.tx_depth,
+                                 codec=self.codec,
+                                 gauge="node.tx_queue_depth",
+                                 span=self._span_label,
+                                 hist="node.tx_s")
+            if self.branch is not None:
+                # announce this connection's join path BEFORE any frame
+                # so the downstream join can slot it (harmless to a
+                # non-join downstream, which ignores the label)
+                tx.send_ctrl({"cmd": "stream_begin",
+                              "path": self.branch})
+        elif self.fan_mode == "broadcast":
+            # branched stage graph: every parallel branch receives every
+            # frame, stamped with one shared sequence number; channel i
+            # is path i of the region (docs/TRANSPORT.md)
+            self.tier_out = "tcp"
+            tx = BroadcastSender(socks, depth=self.tx_depth,
                                  codec=self.codec,
                                  gauge="node.tx_queue_depth",
                                  span=self._span_label,
@@ -314,6 +378,26 @@ class StageNode:
                 self.fan_in = max(1, int(msg["fan_in"]))
             if msg.get("replica") is not None:
                 self.replica = int(msg["replica"])
+            # branched stage-graph role (docs/TRANSPORT.md): broadcast
+            # fork, labeled branch path, or P-path join
+            if msg.get("fan"):
+                if msg["fan"] not in ("rr", "broadcast"):
+                    raise ValueError(f"deploy: fan must be rr|broadcast, "
+                                     f"got {msg['fan']!r}")
+                self.fan_mode = msg["fan"]
+            if msg.get("branch") is not None:
+                self.branch = int(msg["branch"])
+            if msg.get("join"):
+                j = int(msg["join"])
+                if j < 2:
+                    raise ValueError(f"deploy: join must be >= 2, got {j}")
+                if self.fan_in > 1:
+                    raise ValueError("deploy: a node cannot be both a "
+                                     "branch join and a replica fan-in")
+                self.join_in = j
+            if msg.get("infer_delay_ms") is not None:
+                self.infer_delay_s = max(
+                    0.0, float(msg["infer_delay_ms"]) / 1e3)
             if msg.get("tier"):
                 # outbound transport-tier policy rides the deploy
                 # handshake, like the hop codec
@@ -385,6 +469,8 @@ class StageNode:
                 "stage": None if m is None else m["index"],
                 "name": None if m is None else m["name"],
                 "replica": self.replica,
+                "branch": self.branch,
+                "join": self.join_in,
                 "fan_in": self.fan_in,
                 "processed": self.processed,
                 "reweights": self.reweights,
@@ -487,7 +573,8 @@ class StageNode:
         payload = {
             "node": {"stage": None if m is None else m["index"],
                      "name": None if m is None else m["name"],
-                     "replica": self.replica, "fan_in": self.fan_in,
+                     "replica": self.replica, "branch": self.branch,
+                     "join": self.join_in, "fan_in": self.fan_in,
                      "port": self.address[1], "codec": self.codec,
                      "tier": self.tier_out or self.tier,
                      "tier_in": self.tier_in},
@@ -506,8 +593,10 @@ class StageNode:
                 "rx_hi": self._wm().take(subscriber, "rx", rx),
                 "tx_hi": self._wm().take(subscriber, "tx", tx),
                 "inflight": reg.gauge("node.inflight").value,
-                "merge": self._merge.qsize()
-                if self._merge is not None else 0,
+                "merge": (self._merge.qsize()
+                          if self._merge is not None
+                          else self._join.qsize()
+                          if self._join is not None else 0),
             },
             "latency": {
                 # per-node / per-channel instruments where they exist
@@ -593,8 +682,13 @@ class StageNode:
         baseline (``--no-overlap``, ``scripts/chain_overlap_smoke.py``).
         With ``fan_in > 1`` every connection instead feeds the shared
         reorder merge (:meth:`_serve_conn_fanin`) and ONE compute loop
-        consumes the merged in-order stream.
+        consumes the merged in-order stream; with ``join_in >= 2`` the
+        connections feed the (path, seq) branch join
+        (:meth:`_serve_conn_join`) and the compute loop applies the
+        multi-input merge program to each complete sequence.
         """
+        if self.join_in >= 2:
+            return self._serve_conn_join(conn, connect_timeout_s)
         if self.fan_in > 1:
             return self._serve_conn_fanin(conn, connect_timeout_s)
         if self.overlap:
@@ -686,9 +780,13 @@ class StageNode:
                             # than replicas): still propagate the stream
                             # shape so the downstream fan-in's END count
                             # and the result server's dial-back hold
+                            # (fan senders and branch-path hops already
+                            # announced themselves in _make_tx)
                             tx, out_socks = self._make_tx(
                                 connect_timeout_s)
-                            if not isinstance(tx, FanOutSender):
+                            if not isinstance(
+                                    tx, (FanOutSender, BroadcastSender)) \
+                                    and self.branch is None:
                                 tx.send_ctrl({"cmd": "stream_begin"})
                         # END + join: every relayed frame is on the wire
                         # before the finally block closes the socket
@@ -773,6 +871,8 @@ class StageNode:
                     raise ValueError(
                         f"stage {self.manifest['index']} expects sample "
                         f"shape {want}, got {tuple(value.shape[1:])}")
+                if self.infer_delay_s:
+                    time.sleep(self.infer_delay_s)  # bench-only device
                 t0 = time.perf_counter()
                 pending.append((t0, seq, self.prog(value), relay_seq))
                 seq += 1
@@ -896,6 +996,8 @@ class StageNode:
                     raise ValueError(
                         f"stage {self.manifest['index']} expects sample "
                         f"shape {want}, got {tuple(value.shape[1:])}")
+                if self.infer_delay_s:
+                    time.sleep(self.infer_delay_s)  # bench-only device
                 t0 = time.perf_counter()
                 y = np.asarray(self.prog(value))
                 dt = time.perf_counter() - t0
@@ -1076,7 +1178,9 @@ class StageNode:
                         # propagate the stream downstream (see the
                         # overlapped loop's marked-but-empty branch)
                         tx, out_socks = self._make_tx(connect_timeout_s)
-                        if not isinstance(tx, FanOutSender):
+                        if not isinstance(
+                                tx, (FanOutSender, BroadcastSender)) \
+                                and self.branch is None:
                             tx.send_ctrl({"cmd": "stream_begin"})
                     tx.close(timeout=connect_timeout_s)
                     return n
@@ -1099,6 +1203,8 @@ class StageNode:
                     raise ValueError(
                         f"stage {self.manifest['index']} expects sample "
                         f"shape {want}, got {tuple(value.shape[1:])}")
+                if self.infer_delay_s:
+                    time.sleep(self.infer_delay_s)  # bench-only device
                 t0 = time.perf_counter()
                 pending.append((t0, seq, self.prog(value)))
                 seq += 1
@@ -1109,6 +1215,205 @@ class StageNode:
             if pending:
                 # reconcile: dispatches abandoned by a failed stream
                 # must not inflate the shared inflight gauge forever
+                inflight_g.dec(len(pending))
+            if out_socks is not None:
+                for s in out_socks:
+                    s.close()
+
+    # -- branch join (this node merges P labeled branch paths) ---------------
+
+    def _serve_conn_join(self, conn, connect_timeout_s: float) -> None:
+        """One upstream connection of a join node: a reader loop that
+        decodes frames on THIS thread (P connections = P parallel
+        decoders) and deposits sequence-stamped tensors into the shared
+        (path, seq) join buffer under the path its ``stream_begin``
+        announced.  Control connections (deploy / stats / trace) are
+        served inline exactly as on every other loop.  Always returns
+        ``None`` — the join compute loop (:meth:`_join_compute`) is the
+        one producer of the stream's tensor count."""
+        path: int | None = None
+        try:
+            while True:
+                kind, value = recv_frame(conn)
+                if kind == K_END:
+                    if path is not None:
+                        self._join.end(path)
+                    return None
+                if kind == K_CTRL:
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "stream_begin":
+                        p = value.get("path")
+                        if path is not None:
+                            continue  # duplicate marker (zero-frame
+                            # paths re-announce at END time): keep slot
+                        if p is None:
+                            raise ValueError(
+                                "join upstream announced a stream with "
+                                "no path label — every hop into a join "
+                                "must ride a labeled branch path")
+                        path = int(p)
+                        self._ensure_join_loop(connect_timeout_s)
+                        self._join.attach(path)
+                        continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "tier_probe":
+                        # join paths are wire-framed by design (ordered
+                        # (path, seq) merge): refuse, the offer degrades
+                        from ..transport.local import answer_probe
+                        answer_probe(conn, value, accept=False)
+                        continue
+                    if isinstance(value, dict) \
+                            and value.get("cmd") == "req_meta":
+                        raise ValueError(
+                            "request-scoped metadata cannot cross a "
+                            "branch join (P paths would reorder it); "
+                            "serve over a linear chain")
+                    self._handle_ctrl(conn, value)
+                    if path is not None and isinstance(value, dict) \
+                            and value.get("cmd") == "trace":
+                        # mid-stream trace context must still cascade
+                        # past an already-open downstream connection;
+                        # duplicates across the P paths are harmless
+                        # (adoption is idempotent)
+                        self._join.put_ctrl(dict(self._pending_trace))
+                    continue
+                if kind == K_TENSOR:
+                    raise ValueError(
+                        "join node received an unsequenced tensor frame "
+                        "— branch hops carry the fork's shared sequence "
+                        "stamp (K_TENSOR_SEQ)")
+                if kind != K_TENSOR_SEQ:
+                    raise ValueError(f"unexpected frame kind {kind}")
+                seq, arr = value
+                if path is None:
+                    raise ValueError(
+                        "tensor before stream_begin on a join path — "
+                        "the upstream must announce its path first")
+                self._join.put(path, seq, arr)
+        except Exception as e:  # noqa: BLE001 — policy matches the
+            # fan-in loop: a registered branch path fails loudly (and
+            # poisons the join so the compute loop fails too); a
+            # connection that never streamed is logged and dropped
+            if path is not None:
+                self._join.fail(e)
+                raise
+            print(f"node: dropped connection before streaming: {e!r}",
+                  file=sys.stderr, flush=True)
+            return None
+
+    def _ensure_join_loop(self, connect_timeout_s: float) -> None:
+        """Create the shared (path, seq) buffer and its single compute
+        thread the first time a branch path announces itself."""
+        with self._merge_lock:
+            if self._join is not None:
+                return
+            self._join = BranchJoin(
+                self.join_in,
+                capacity=max(2, self.rx_depth))
+            t = threading.Thread(
+                target=self._join_loop, args=(connect_timeout_s,),
+                daemon=True, name="node-join-compute")
+            t.start()
+
+    def _join_loop(self, connect_timeout_s: float) -> None:
+        done = self._done_q
+        try:
+            done.put(self._join_compute(connect_timeout_s))
+        except BaseException as e:  # noqa: BLE001 — surfaced via serve()
+            self._join.fail(e)  # wake readers parked in put()
+            done.put(e)
+
+    def _join_compute(self, connect_timeout_s: float) -> int:
+        """The join node's compute loop: consume complete (all P paths)
+        sequences strictly in order, run the multi-input merge program,
+        relay downstream with the sequence stamp preserved.  Same shape
+        as :meth:`_merge_compute`, with the (path, seq) join in place of
+        the round-robin merge and ``prog(*parts)`` in place of
+        ``prog(x)``."""
+        import queue as _q
+
+        tx = None
+        out_socks = None
+        n = 0
+        infer_hist = REGISTRY.histogram("node.infer_s")
+        inflight_g = REGISTRY.gauge("node.inflight")
+        join_g = REGISTRY.gauge("node.merge_depth")
+        pending: collections.deque = collections.deque()
+
+        def drain_one():
+            nonlocal n
+            t0, s, y = pending.popleft()
+            inflight_g.dec()
+            y = np.asarray(y)
+            dt = time.perf_counter() - t0
+            infer_hist.record(dt)
+            if self.infer_hist is not None:
+                self.infer_hist.record(dt)
+            tr = tracer()
+            if tr.enabled:
+                tr.record(f"{self._span_label()}.infer", t0, dt,
+                          {"seq": s, "stage": self.manifest["index"]})
+            self.processed += 1
+            tx.send(y, seq=s)  # relay the region's stamp downstream
+            n += 1
+
+        def want_shapes() -> list[tuple]:
+            m = self.manifest
+            if m.get("in_shapes"):
+                return [tuple(s) for s in m["in_shapes"]]
+            return [tuple(m["in_shape"])] * self.join_in
+
+        try:
+            while True:
+                if pending:
+                    try:
+                        kind, value = self._join.get_nowait()
+                    except _q.Empty:
+                        drain_one()
+                        continue
+                else:
+                    kind, value = self._join.get()
+                join_g.v = self._join.qsize()
+                if kind == K_END:
+                    while pending:
+                        drain_one()
+                    if tx is None:
+                        tx, out_socks = self._make_tx(connect_timeout_s)
+                        if not isinstance(
+                                tx, (FanOutSender, BroadcastSender)) \
+                                and self.branch is None:
+                            tx.send_ctrl({"cmd": "stream_begin"})
+                    tx.close(timeout=connect_timeout_s)
+                    return n
+                if kind == K_CTRL:
+                    # the readers handled the command (trace adoption);
+                    # what rides through the join is the cascade copy
+                    if tx is not None and value is not None:
+                        tx.send_ctrl(value)
+                    continue
+                seq, parts = value
+                if self.prog is None:
+                    raise ValueError(
+                        "data frame before any stage artifact (boot with "
+                        "--artifact or deploy in-band first)")
+                if tx is None:
+                    tx, out_socks = self._make_tx(connect_timeout_s)
+                for p, (part, want) in enumerate(
+                        zip(parts, want_shapes())):
+                    if tuple(part.shape[1:]) != want:
+                        raise ValueError(
+                            f"join stage {self.manifest['index']} path "
+                            f"{p} expects sample shape {want}, got "
+                            f"{tuple(part.shape[1:])}")
+                if self.infer_delay_s:
+                    time.sleep(self.infer_delay_s)
+                t0 = time.perf_counter()
+                pending.append((t0, seq, self.prog(*parts)))
+                inflight_g.inc()
+                while len(pending) >= self.inflight:
+                    drain_one()
+        finally:
+            if pending:
                 inflight_g.dec(len(pending))
             if out_socks is not None:
                 for s in out_socks:
@@ -1408,6 +1713,56 @@ class ChainDispatcher:
                     send_end(s)
                 finally:
                     s.close()
+
+    def deploy_topology(self, topology, stages, params,
+                        node_addrs: Sequence[str], *, batch: int = 1,
+                        result_hop: str | None = None,
+                        stage_delays: dict | None = None):
+        """Ship a branched stage graph: one node per topology vertex.
+
+        ``topology`` is a :class:`~defer_tpu.runtime.topology.ChainTopology`
+        whose vertices align with ``stages`` (from
+        ``topology.stage_specs(graph)``) and ``node_addrs``.  Each deploy
+        message carries the vertex's transport role — ``fan`` (broadcast
+        fork), ``branch`` (labeled path), ``join`` (P-path merge) — on
+        top of the usual next/codec pair; replicas never appear here
+        (branch fan machinery and replica fan machinery own different
+        sequence namespaces, and mixing them is rejected loudly at the
+        node).  ``stage_delays`` (vid -> seconds) installs the bench-only
+        simulated device time per vertex."""
+        from ..utils.export import export_stage_bytes
+        addrs = list(node_addrs)
+        if len(addrs) != len(topology.vertices) or \
+                len(stages) != len(topology.vertices):
+            raise ValueError(
+                f"{len(topology.vertices)} topology vertices need as "
+                f"many stages ({len(stages)}) and addresses "
+                f"({len(addrs)})")
+        result_hop = result_hop or \
+            f"{self.result_address[0]}:{self.result_address[1]}"
+        for v, stage, addr in zip(topology.vertices, stages, addrs):
+            nxt = ",".join(addrs[n] for n in v.next) if v.next \
+                else result_hop
+            msg = {"cmd": "deploy", "next": nxt,
+                   "codec": v.codec or self.codec}
+            if v.fan == "broadcast":
+                msg["fan"] = "broadcast"
+            if v.join >= 2:
+                msg["join"] = v.join
+            if v.branch is not None:
+                msg["branch"] = v.branch
+            if stage_delays and stage_delays.get(v.vid):
+                msg["infer_delay_ms"] = stage_delays[v.vid] * 1e3
+            blob = export_stage_bytes(stage, params, batch=batch)
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=self.timeout_s)
+            try:
+                send_ctrl(s, msg)
+                send_frame(s, blob)
+                recv_expect(s, K_ACK)
+                send_end(s)
+            finally:
+                s.close()
 
     def reweight(self, stages, params, node_addrs: Sequence[str]):
         """Weights-only re-push: install fresh weights on every node's
@@ -1871,6 +2226,7 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               hop_codecs: Sequence[str] | None = None,
               hop_tiers: Sequence[str] | None = None,
               tier: str = "auto",
+              stage_delays: Sequence[float] | None = None,
               stats_out: list | None = None,
               spawn_retries: int = 3,
               on_spawn=None,
@@ -1971,6 +2327,12 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                 f"({n}), got {len(hop_codecs)}")
         codec_of = list(hop_codecs) if hop_codecs is not None \
             else [codec] * n
+        if stage_delays is not None and len(stage_delays) != n:
+            raise ValueError(
+                f"stage_delays must have one entry per stage "
+                f"({n}), got {len(stage_delays)}")
+        delay_of = [float(d) for d in stage_delays] \
+            if stage_delays is not None else [0.0] * n
         if tier not in ("tcp", "auto"):
             raise ValueError(f"tier must be tcp|auto, got {tier!r}")
         tiers = _normalize_hop_tiers(hop_tiers, n, r_of, tier)
@@ -1990,6 +2352,7 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
             stages, groups = fuse_stages(list(stages), tiers)
             r_of = [r_of[g[0]] for g in groups]
             codec_of = [codec_of[g[-1]] for g in groups]
+            delay_of = [sum(delay_of[i] for i in g) for g in groups]
             tiers = [tiers[g[-1]] for g in groups[:-1]]
             n = len(stages)
         # colocation groups: maximal runs of stages joined by "local"
@@ -2034,7 +2397,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                     trace_sample_every=trace_sample_every,
                     plan=plan, graph=graph,
                     report_interval_ms=report_interval_ms,
-                    coloc=coloc, tier_of=tier_of, tier=tier)
+                    coloc=coloc, tier_of=tier_of, tier=tier,
+                    delay_of=delay_of)
             except _BindRace as e:
                 last_exc = e
                 print(f"run_chain: bind race on attempt {attempt + 1} "
@@ -2099,7 +2463,7 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                    rx_depth, tx_depth, stats_out, on_spawn,
                    trace_sample_every=0, plan=None, graph=None,
                    report_interval_ms=250.0, coloc=None, tier_of=None,
-                   tier="tcp"):
+                   tier="tcp", delay_of=None):
     """One spawn -> deploy -> stream -> teardown attempt (see
     ``run_chain``).  Raises :class:`_BindRace` when a child died with an
     address-in-use failure; any other failure surfaces the dead node's
@@ -2140,6 +2504,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             flags += ["--fan-in", str(r_of[k - 1])]
         if r_of[k] > 1:
             flags += ["--replica", str(j)]
+        if delay_of and delay_of[k]:
+            flags += ["--infer-delay-ms", str(delay_of[k] * 1e3)]
         return flags
 
     #: spawn units: one OS process each, hosting >= 1 (stage, replica)
@@ -2308,6 +2674,231 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             raise RuntimeError(
                 f"chain failed ({type(e).__name__}: {e}); dead nodes: "
                 f"{detail}") from e
+        raise
+    finally:
+        for lf in logs:
+            lf.close()
+
+
+def run_dag_chain(graph, params, inputs, *, topology, batch: int = 1,
+                  codec: str = "raw", artifact_dir: str | None = None,
+                  env: dict[str, str] | None = None,
+                  rx_depth: int | None = None, tx_depth: int | None = None,
+                  inflight: int | None = None,
+                  stage_delays: dict | None = None,
+                  replicas=None, hop_tiers=None,
+                  stats_out: list | None = None,
+                  spawn_retries: int = 3, on_spawn=None,
+                  trace_sample_every: int = 0) -> "list[np.ndarray]":
+    """Spawn a BRANCHED process pipeline — one OS process per topology
+    vertex — stream, tear down (the DAG analogue of :func:`run_chain`).
+
+    ``topology`` is a :class:`~defer_tpu.runtime.topology.ChainTopology`
+    (typically ``ChainTopology.from_json`` of a ``plan --dag --json``
+    document): trunk vertices relay as usual, a fork vertex broadcasts
+    every frame to all of its region's paths with a shared sequence
+    stamp, branch vertices ride labeled paths, and the join vertex
+    merges all P paths per sequence before running the graph's merge op
+    (docs/TRANSPORT.md).  Outputs return in order, byte-identical to the
+    single-process forward.
+
+    ``stage_delays`` (vertex id -> seconds) installs bench-only
+    simulated device time per vertex (``node --infer-delay-ms``) — how
+    ``scripts/dag_smoke.py`` expresses branch compute on a small host.
+
+    Replication and colocation tiers do NOT compose with branch
+    topologies (the ordered fan machineries own different sequence
+    namespaces; every branch hop is wire-framed): ``replicas`` /
+    ``hop_tiers`` are rejected loudly rather than silently ignored.
+    """
+    from ..utils.export import export_stage
+
+    if replicas:
+        raise ValueError(
+            "replicas do not compose with a branched topology (a branch "
+            "hop touching a replicated stage is rejected like any fan "
+            "hop); drop the replicas or run a linear chain")
+    if hop_tiers:
+        raise ValueError(
+            "hop_tiers do not compose with a branched topology yet — "
+            "every branch fan-out/join hop is wire-framed by design")
+    stages = topology.stage_specs(graph)
+    tmp = None
+    if artifact_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="defer_dag_")
+        artifact_dir = tmp.name
+    try:
+        paths = []
+        for v, stage in zip(topology.vertices, stages):
+            p = os.path.join(artifact_dir, f"vertex_{v.vid}.zip")
+            export_stage(stage, params, p, batch=batch)
+            paths.append(p)
+
+        child_env = dict(os.environ)
+        if env is None:
+            env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        child_env.update(env)
+        tuning = []
+        for flag, val in (("--rx-depth", rx_depth),
+                          ("--tx-depth", tx_depth),
+                          ("--inflight", inflight)):
+            if val is not None:
+                tuning += [flag, str(val)]
+
+        last_exc: BaseException | None = None
+        for attempt in range(max(1, spawn_retries)):
+            try:
+                return _dag_attempt(
+                    topology, paths, inputs, codec=codec,
+                    child_env=child_env, artifact_dir=artifact_dir,
+                    tuning=tuning, rx_depth=rx_depth, tx_depth=tx_depth,
+                    stage_delays=stage_delays or {},
+                    stats_out=stats_out, on_spawn=on_spawn,
+                    trace_sample_every=trace_sample_every)
+            except _BindRace as e:
+                last_exc = e
+                print(f"run_dag_chain: bind race on attempt "
+                      f"{attempt + 1} ({e}); retrying on fresh ports",
+                      file=sys.stderr, flush=True)
+        raise RuntimeError(
+            f"dag chain spawn lost the port race {spawn_retries} times: "
+            f"{last_exc}") from last_exc
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def dag_vertex_argv(v, artifact: str, *, addrs, result_addr: str,
+                    codec: str = "raw",
+                    stage_delays: dict | None = None) -> list[str]:
+    """argv for one topology vertex's ``defer_tpu node`` process — the
+    single source of truth for the branched deployment shape
+    (:func:`run_dag_chain` and ``scripts/dag_smoke.py`` both spawn
+    through it, so the bench always measures what ``chain --dag``
+    ships)."""
+    nxt = ",".join(addrs[n] for n in v.next) if v.next else result_addr
+    argv = [sys.executable, "-m", "defer_tpu", "node",
+            "--listen", addrs[v.vid], "--artifact", artifact,
+            "--next", nxt, "--codec", v.codec or codec,
+            "--tier", "tcp"]
+    if v.fan == "broadcast":
+        argv += ["--fan", "broadcast"]
+    if v.branch is not None:
+        argv += ["--branch", str(v.branch)]
+    if v.join >= 2:
+        argv += ["--join", str(v.join)]
+    if stage_delays and stage_delays.get(v.vid):
+        argv += ["--infer-delay-ms", str(stage_delays[v.vid] * 1e3)]
+    return argv
+
+
+def _dag_attempt(topology, paths, inputs, *, codec, child_env,
+                 artifact_dir, tuning, rx_depth, tx_depth, stage_delays,
+                 stats_out, on_spawn, trace_sample_every=0):
+    """One spawn -> stream -> teardown attempt of a branched topology
+    (see :func:`run_dag_chain`); same bind-race/teardown discipline as
+    :func:`_chain_attempt`."""
+    vs = topology.vertices
+    ports = _free_ports(len(vs) + 1)
+    result_port = ports[-1]
+    addrs = [f"127.0.0.1:{ports[i]}" for i in range(len(vs))]
+
+    def argv_for(v, path):
+        return dag_vertex_argv(
+            v, path, addrs=addrs,
+            result_addr=f"127.0.0.1:{result_port}", codec=codec,
+            stage_delays=stage_delays) + tuning
+
+    procs, logs = [], []
+    labels = [v.label for v in vs]
+    failure: BaseException | None = None
+    try:
+        for v, path in zip(vs, paths):
+            lf = open(os.path.join(artifact_dir,
+                                   f"node_{v.label.replace('.', '_')}"
+                                   f".log"), "w+")
+            logs.append(lf)
+            procs.append(subprocess.Popen(
+                argv_for(v, path), env=child_env, stdout=lf,
+                stderr=subprocess.STDOUT))
+        if on_spawn is not None:
+            on_spawn(procs)
+        # identity proc_of: exact per-address "listening on" matching
+        _await_binds(procs, labels, logs, addrs,
+                     proc_of=list(range(len(vs))))
+
+        try:
+            disp = ChainDispatcher(addrs[0],
+                                   listen=f"127.0.0.1:{result_port}",
+                                   codec=codec,
+                                   tx_depth=tx_depth if tx_depth else 8,
+                                   rx_depth=rx_depth if rx_depth else 8,
+                                   trace_sample_every=trace_sample_every,
+                                   tier="tcp")
+        except OSError as e:
+            import errno
+            if getattr(e, "errno", None) == errno.EADDRINUSE \
+                    or any(m in str(e) for m in _BIND_RACE_MARKS):
+                raise _BindRace(
+                    f"dispatcher lost the result-port bind race "
+                    f"({e})") from e
+            raise
+        try:
+            if tracer().enabled:
+                try:
+                    disp.align_clocks(addrs)
+                except (OSError, ConnectionError) as e:
+                    print(f"run_dag_chain: clock alignment failed: "
+                          f"{e!r}", file=sys.stderr)
+            outs = disp.stream(inputs)
+            if stats_out is not None:
+                stats_out.extend(disp.stats(addrs))
+            if tracer().enabled:
+                try:
+                    disp.collect_trace(addrs)
+                except (OSError, ConnectionError) as e:
+                    print(f"run_dag_chain: trace collection failed: "
+                          f"{e!r}", file=sys.stderr)
+        except BaseException as e:
+            failure = e
+            raise
+        finally:
+            if failure is not None:
+                _kill_procs(procs)
+            disp.close()
+            if failure is None:
+                for pr in procs:
+                    try:
+                        pr.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pr.kill()
+        for i, pr in enumerate(procs):
+            if pr.returncode not in (0, None):
+                raise RuntimeError(
+                    f"dag node {labels[i]} exited rc={pr.returncode}: "
+                    f"{_log_tail(logs[i])}")
+        return outs
+    except _BindRace:
+        _kill_procs(procs)
+        raise
+    except BaseException as e:
+        _kill_procs(procs)
+        dead = [(labels[i], pr.returncode, _log_tail(logs[i]))
+                for i, pr in enumerate(procs)
+                if pr.returncode not in (0, None)]
+        races = [d for d in dead
+                 if any(m in d[2] for m in _BIND_RACE_MARKS)]
+        if races and all(d in races for d in dead):
+            raise _BindRace(
+                f"{[d[0] for d in races]} lost the port bind race") from e
+        if dead and not isinstance(e, RuntimeError):
+            detail = "; ".join(
+                f"node {lbl} rc={rc}: ...{tail[-800:]}"
+                for lbl, rc, tail in dead)
+            raise RuntimeError(
+                f"dag chain failed ({type(e).__name__}: {e}); dead "
+                f"nodes: {detail}") from e
         raise
     finally:
         for lf in logs:

@@ -4,4 +4,5 @@ from .framed import (K_BYTES, K_END, K_TENSOR, K_TENSOR_SEQ, TensorClient,
                      send_frame)
 from .local import (LocalPipe, LocalReceiver, LocalSender, grant_local,
                     offer_local)
+from .branch import BranchJoin, BroadcastSender
 from .replicate import FanInMerge, FanOutSender

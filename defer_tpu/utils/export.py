@@ -66,14 +66,18 @@ def export_stage_bytes(stage: StageSpec, params: dict[str, Any],
     leaves, treedef = jax.tree.flatten(sp)
     leaves = [np.asarray(l) for l in leaves]
 
-    def fn(flat_leaves, x):
+    def fn(flat_leaves, *xs):
         p = jax.tree.unflatten(treedef, flat_leaves)
-        return stage.fn(p, x)
+        return stage.fn(p, *xs)
 
-    x_spec = jax.ShapeDtypeStruct((batch,) + stage.in_spec.shape,
-                                  stage.in_spec.dtype)
+    # a JoinStageSpec (branched pipelines, docs/TRANSPORT.md) takes P
+    # boundary tensors — one per merged branch path, in path order
+    in_specs = tuple(getattr(stage, "in_specs", None)
+                     or (stage.in_spec,))
+    x_specs = [jax.ShapeDtypeStruct((batch,) + s.shape, s.dtype)
+               for s in in_specs]
     leaf_specs = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
-    exported = jax_export.export(jax.jit(fn))(leaf_specs, x_spec)
+    exported = jax_export.export(jax.jit(fn))(leaf_specs, *x_specs)
     blob = exported.serialize()
 
     manifest = {
@@ -81,15 +85,20 @@ def export_stage_bytes(stage: StageSpec, params: dict[str, Any],
         "index": stage.index,
         "name": stage.name,
         "graph": stage.graph.name,
-        "input": stage.input_name,
+        "input": getattr(stage, "input_name", None)
+        or ",".join(stage.input_names),
         "output": stage.output_name,
         "batch": batch,
-        "in_shape": list(stage.in_spec.shape),
-        "in_dtype": stage.in_spec.dtype.name,
+        "in_shape": list(in_specs[0].shape),
+        "in_dtype": in_specs[0].dtype.name,
         "out_shape": list(stage.out_spec.shape),
         "out_dtype": stage.out_spec.dtype.name,
         "num_weights": len(leaves),
     }
+    if len(in_specs) > 1:
+        manifest["num_inputs"] = len(in_specs)
+        manifest["in_shapes"] = [list(s.shape) for s in in_specs]
+        manifest["in_dtypes"] = [s.dtype.name for s in in_specs]
     out = io.BytesIO()
     with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr(_MANIFEST, json.dumps(manifest, indent=1))
@@ -130,7 +139,9 @@ class StageProgram:
                 f"got {len(leaves)}")
         call = self._exported.call
         self._leaves = leaves
-        self.fn = jax.jit(lambda x: call(leaves, x))
+        # *xs: a join-stage artifact (manifest["num_inputs"] > 1) takes
+        # one array per merged branch path, single-input stages just one
+        self.fn = jax.jit(lambda *xs: call(leaves, *xs))
 
     def reweight(self, blob: bytes):
         """Install a weights npz blob (shapes must match the artifact's)."""
@@ -142,8 +153,8 @@ class StageProgram:
                     f"re-push has {nw.shape}/{nw.dtype}")
         self._install(new)
 
-    def __call__(self, x):
-        return self.fn(x)
+    def __call__(self, *xs):
+        return self.fn(*xs)
 
 
 def load_stage_program(src) -> StageProgram:

@@ -1,0 +1,146 @@
+"""Branch fan-out/join transport tests: the ``(path, seq)`` reorder
+buffer's ordering, duplicate/stale/END-gap edges, backpressure liveness,
+and failure propagation (docs/TRANSPORT.md)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from defer_tpu.transport.branch import BranchJoin, BroadcastSender
+from defer_tpu.transport.framed import K_CTRL, K_END, K_TENSOR_SEQ
+
+
+def drain(j, timeout=5.0):
+    out = []
+    while True:
+        kind, value = j.get(timeout=timeout)
+        out.append((kind, value))
+        if kind == K_END:
+            return out
+
+
+def test_join_orders_across_racing_paths():
+    j = BranchJoin(3)
+    n = 20
+
+    def feeder(path, order):
+        j.attach(path)
+        for seq in order:
+            j.put(path, seq, (path, seq))
+        j.end(path)
+
+    rng_orders = [list(range(n)), list(range(n))[::-1],
+                  sorted(range(n), key=lambda s: s % 4)]
+    # path 0 in order; path 1 reversed; path 2 shuffled: the consumer
+    # must still see 0..n-1 strictly in order, parts in path order
+    threads = [threading.Thread(target=feeder, args=(p, o))
+               for p, o in enumerate(rng_orders)]
+    for t in threads:
+        t.start()
+    items = drain(j)
+    for t in threads:
+        t.join()
+    tensors = [v for k, v in items if k == K_TENSOR_SEQ]
+    assert [s for s, _ in tensors] == list(range(n))
+    for s, parts in tensors:
+        assert parts == [(0, s), (1, s), (2, s)]
+    assert items[-1] == (K_END, None)
+
+
+def test_join_duplicate_and_stale_raise():
+    j = BranchJoin(2)
+    j.attach(0)
+    j.attach(1)
+    j.put(0, 0, "a")
+    with pytest.raises(ValueError, match="duplicate"):
+        j.put(0, 0, "again")
+    j.put(1, 0, "b")
+    assert j.get() == (K_TENSOR_SEQ, (0, ["a", "b"]))
+    with pytest.raises(ValueError, match="stale"):
+        j.put(0, 0, "late")
+
+
+def test_join_end_gap_raises():
+    """All paths ended but a seq is missing a part: the gap names the
+    missing (seq, paths) instead of silently truncating the stream."""
+    j = BranchJoin(2)
+    j.attach(0)
+    j.attach(1)
+    j.put(0, 0, "a")
+    j.end(0)
+    j.end(1)      # path 1 never delivered seq 0
+    with pytest.raises(ConnectionError, match="missing"):
+        j.get(timeout=1.0)
+
+
+def test_join_double_end_and_double_attach_raise():
+    j = BranchJoin(2)
+    j.attach(0)
+    with pytest.raises(ConnectionError, match="claimed"):
+        j.attach(0)
+    j.attach(1)
+    j.end(0)
+    j.end(0)      # poisoned: surfaced at the consumer
+    with pytest.raises(ConnectionError, match="two END"):
+        j.get(timeout=1.0)
+
+
+def test_join_path_range_checked():
+    j = BranchJoin(2)
+    with pytest.raises(ValueError, match="out of range"):
+        j.attach(2)
+    with pytest.raises(ValueError, match="out of range"):
+        j.put(5, 0, "x")
+    with pytest.raises(ValueError):
+        BranchJoin(1)
+
+
+def test_join_backpressure_liveness():
+    """A full buffer parks depositors EXCEPT for frames landing in an
+    existing slot or opening the consumer's next needed seq — the frame
+    everyone waits on is always admitted."""
+    j = BranchJoin(2, capacity=2)
+    j.attach(0)
+    j.attach(1)
+    j.put(0, 1, "b1")
+    j.put(0, 2, "b2")          # two distinct seqs buffered: full
+    with pytest.raises(TimeoutError, match="full"):
+        j.put(0, 3, "b3", timeout=0.2)
+    j.put(1, 1, "c1")          # existing slot: admitted while full
+    j.put(1, 0, "c0")          # opens seq 0 — THE next needed: admitted
+    j.put(0, 0, "b0")
+    assert j.get(timeout=1.0) == (K_TENSOR_SEQ, (0, ["b0", "c0"]))
+    assert j.get(timeout=1.0) == (K_TENSOR_SEQ, (1, ["b1", "c1"]))
+
+
+def test_join_ctrl_rides_ahead_and_fail_propagates():
+    j = BranchJoin(2)
+    j.attach(0)
+    j.put(0, 0, "x")
+    j.put_ctrl({"cmd": "trace"})
+    assert j.get(timeout=1.0) == (K_CTRL, {"cmd": "trace"})
+    with pytest.raises(queue.Empty):
+        j.get_nowait()         # seq 0 still missing path 1
+    j.fail(ConnectionError("branch died"))
+    with pytest.raises(ConnectionError, match="branch died"):
+        j.get(timeout=1.0)
+    # producers parked in put() wake up with the same failure
+    with pytest.raises(ConnectionError, match="branch died"):
+        j.put(0, 1, "y")
+
+
+def test_join_get_timeout_reports_progress():
+    j = BranchJoin(3)
+    j.attach(0)
+    j.put(0, 0, "only")
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="1/3"):
+        j.get(timeout=0.2)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_broadcast_sender_needs_two_channels():
+    with pytest.raises(ValueError, match=">= 2"):
+        BroadcastSender([object()])

@@ -1,0 +1,293 @@
+"""Branched (DAG) chain runtime tests: in-process thread-node
+deployments of fork/join topologies must be byte-identical to the serial
+composition of their own stage programs, with per-branch attribution in
+stats — plus the loud non-composition rules (replicas / hop tiers) and
+the ``chain --dag`` CLI guard rails (docs/TRANSPORT.md)."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from defer_tpu.graph.ir import GraphBuilder
+from defer_tpu.graph import ops
+from defer_tpu.models import moe_branched_tiny
+from defer_tpu.plan import StageCostModel, solve_dag
+from defer_tpu.runtime.node import ChainDispatcher, StageNode, run_dag_chain
+from defer_tpu.runtime.topology import ChainTopology
+from defer_tpu.utils.export import export_stage_bytes, load_stage_program
+
+
+def two_branch_graph():
+    """input -> stem -> {b0: 2 Dense, b1: 1 Dense, residual} -> Add ->
+    out: one region with an empty branch, small enough for fast
+    exports."""
+    b = GraphBuilder("twobranch")
+    x = b.input((8,))
+    x = b.add(ops.Dense(8), x, name="stem")
+    p = b.add(ops.Dense(8), x, name="b0n0")
+    p = b.add(ops.Dense(8), p, name="b0n1")
+    q = b.add(ops.Dense(8), x, name="b1n0")
+    x = b.add(ops.Add(), [x, p, q], name="join")
+    x = b.add(ops.Dense(4), x, name="head")
+    return b.build()
+
+
+def deploy_inproc(graph, topo, params, xs, *, batch=1, streams=1):
+    """Thread-per-vertex deployment; returns (outs of the LAST stream,
+    stats rows)."""
+    stages = topo.stage_specs(graph)
+    nodes = [StageNode(None, "127.0.0.1:0", None) for _ in topo.vertices]
+    addrs = [f"127.0.0.1:{n.address[1]}" for n in nodes]
+    threads = [threading.Thread(target=n.serve, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    disp = ChainDispatcher(addrs[0], codec="raw")
+    try:
+        disp.deploy_topology(topo, stages, params, addrs, batch=batch)
+        for _ in range(streams):
+            outs = disp.stream(xs)
+        stats = disp.stats(addrs)
+    finally:
+        disp.close()
+    for t in threads:
+        t.join(timeout=60)
+    return outs, stats
+
+
+def serial_reference(graph, topo, params, xs, *, batch=1):
+    """Serial composition of the deployment's own stage programs — the
+    exact byte-identity contract (the fused single program differs in
+    XLA fusion by ~1e-6; `graph.apply` closeness is asserted separately)."""
+    progs = [load_stage_program(export_stage_bytes(s, params, batch=batch))
+             for s in topo.stage_specs(graph)]
+    graph_input = topo.entry.inputs[0]
+    outs = []
+    for x in xs:
+        vals = {}
+        for v, p in zip(topo.vertices, progs):
+            ins = [x if name == graph_input else vals[name]
+                   for name in v.inputs]
+            vals[v.output] = np.asarray(p(*ins))
+        outs.append(vals[topo.exit.output])
+    return outs
+
+
+def solved_topology(graph, *, heavy, budget):
+    costs = {n: heavy.get(n, 1e-6) for n in graph.topo_order}
+    cm = StageCostModel(graph, gen="v5e", link_bw_s=1e12,
+                        node_costs=costs)
+    plan = solve_dag(graph, cm, num_nodes=budget)
+    assert plan.parallel_regions, plan.to_json()
+    return ChainTopology.from_json(plan.topology_json())
+
+
+def test_branched_chain_byte_identity_two_branch():
+    g = two_branch_graph()
+    params = g.init(jax.random.key(0))
+    topo = solved_topology(
+        g, heavy={"b0n0": 1e-3, "b0n1": 1e-3, "b1n0": 2e-3}, budget=5)
+    assert any(v.fan == "broadcast" for v in topo.vertices)
+    join = next(v for v in topo.vertices if v.join >= 2)
+    assert join.join == 3          # two real branches + residual skip
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((1, 8)).astype(np.float32)
+          for _ in range(8)]
+    outs, stats = deploy_inproc(g, topo, params, xs)
+    ref = serial_reference(g, topo, params, xs)
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    fwd = jax.jit(g.apply)
+    for x, y in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(fwd(params, x)), y,
+                                   rtol=1e-5, atol=1e-5)
+    # every branch vertex saw every frame (broadcast, not round-robin),
+    # and stats attribute rows to their branch path
+    # path 0 is the residual skip (a direct fork->join channel, no
+    # vertex); the two real branches ride paths 1 and 2
+    per_branch = {s["branch"]: s["processed"] for s in stats
+                  if s.get("branch") is not None}
+    assert per_branch == {1: len(xs), 2: len(xs)}
+    assert any(s.get("join") == 3 for s in stats)
+
+
+def test_branched_chain_multi_stream_and_order():
+    """Several stream() calls ride one deployment (the fork's shared
+    sequence stamp keeps advancing), outputs strictly in input order."""
+    g = two_branch_graph()
+    params = g.init(jax.random.key(0))
+    topo = solved_topology(
+        g, heavy={"b0n0": 1e-3, "b0n1": 1e-3, "b1n0": 2e-3}, budget=5)
+    # distinguishable frames: ordering mistakes change outputs
+    xs = [np.full((1, 8), i, np.float32) for i in range(6)]
+    outs, _ = deploy_inproc(g, topo, params, xs, streams=3)
+    ref = serial_reference(g, topo, params, xs)
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_branched_chain_byte_identity_moe_branched_tiny():
+    """The expert-parallel MoE scenario: both 4-expert regions fanned
+    out (11 vertices), byte-identical to the serial forward."""
+    g = moe_branched_tiny(seq_len=8)
+    params = g.init(jax.random.key(0))
+    heavy = {n: 1e-3 for n in g.topo_order
+             if n.startswith("block_") or "_e" in n}
+    topo = solved_topology(g, heavy=heavy, budget=12)
+    assert sum(1 for v in topo.vertices if v.join >= 2) == 2
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, 100, (1, 8)).astype(np.int32)
+          for _ in range(4)]
+    outs, stats = deploy_inproc(g, topo, params, xs)
+    ref = serial_reference(g, topo, params, xs)
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    fwd = jax.jit(g.apply)
+    for x, y in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(fwd(params, x)), y,
+                                   rtol=1e-4, atol=1e-4)
+    branch_rows = [s for s in stats if s.get("branch") is not None]
+    assert len(branch_rows) == 8   # 4 experts x 2 layers
+    assert all(s["processed"] == len(xs) for s in branch_rows)
+
+
+def test_branched_chain_byte_identity_inception_tiny():
+    """The multi-branch vision scenario: 306 nodes, 5 vertices around
+    the mixed_3 reduction region (scripts/dag_smoke.py measures the
+    same deployment's speedup; this asserts just the identity)."""
+    from defer_tpu.models import inception_tiny
+
+    g = inception_tiny()
+    params = g.init(jax.random.key(0))
+    heavy = {}
+    from defer_tpu.graph.analysis import branch_regions
+    region = next(r for r in branch_regions(g) if r.join == "mixed_3")
+    for b in region.branches[:2]:
+        for n in b.nodes:
+            heavy[n] = 1e-3
+    topo = solved_topology(g, heavy=heavy, budget=5)
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((1, 75, 75, 3)).astype(np.float32)
+          for _ in range(3)]
+    outs, _ = deploy_inproc(g, topo, params, xs)
+    ref = serial_reference(g, topo, params, xs)
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+@pytest.mark.slow
+def test_run_dag_chain_real_processes():
+    """run_dag_chain spawns the branched topology as real OS `defer_tpu
+    node` processes (the `chain --dag` path) — byte-identical to the
+    serial composition of its own stage programs."""
+    g = two_branch_graph()
+    params = g.init(jax.random.key(0))
+    topo = solved_topology(
+        g, heavy={"b0n0": 1e-3, "b0n1": 1e-3, "b1n0": 2e-3}, budget=5)
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((1, 8)).astype(np.float32)
+          for _ in range(5)]
+    stats: list = []
+    outs = run_dag_chain(g, params, xs, topology=topo, stats_out=stats)
+    ref = serial_reference(g, topo, params, xs)
+    for a, b in zip(ref, outs):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    per_branch = {s["branch"]: s["processed"] for s in stats
+                  if s.get("branch") is not None}
+    assert per_branch == {1: len(xs), 2: len(xs)}
+
+
+def test_run_dag_chain_rejects_replicas_and_tiers():
+    """Branch fan machinery and replica/colocation machinery own
+    different sequence namespaces: composing them fails loudly BEFORE
+    any process spawns."""
+    g = two_branch_graph()
+    params = g.init(jax.random.key(0))
+    topo = solved_topology(
+        g, heavy={"b0n0": 1e-3, "b0n1": 1e-3, "b1n0": 2e-3}, budget=5)
+    with pytest.raises(ValueError, match="replicas"):
+        run_dag_chain(g, params, [], topology=topo,
+                      replicas={1: 2})
+    with pytest.raises(ValueError, match="hop_tiers"):
+        run_dag_chain(g, params, [], topology=topo,
+                      hop_tiers={"stem": "local"})
+
+
+def test_node_role_flags_validated():
+    with pytest.raises(ValueError, match="join_in"):
+        StageNode(None, "127.0.0.1:0", None, join_in=1)
+    with pytest.raises(ValueError, match="fan_mode"):
+        StageNode(None, "127.0.0.1:0", None, fan_mode="multicast")
+    with pytest.raises(ValueError, match="replica fan-in"):
+        StageNode(None, "127.0.0.1:0", None, join_in=2, fan_in=2)
+
+
+def test_cli_chain_dag_guard_rails(capsys):
+    from defer_tpu.cli import main
+    with pytest.raises(SystemExit, match="replicas"):
+        main(["chain", "--model", "moe_branched_tiny", "--dag",
+              "--replicas", "stage1=2"])
+    with pytest.raises(SystemExit, match="wire-framed"):
+        main(["chain", "--model", "moe_branched_tiny", "--dag",
+              "--hop-tiers", "local"])
+    with pytest.raises(SystemExit, match="linear planner"):
+        main(["chain", "--model", "moe_branched_tiny", "--dag",
+              "--cuts", "block_0"])
+
+
+def test_monitor_renders_branch_column(capsys):
+    """The monitor's BR column shows stageK.bJ / join rows, so the
+    bottleneck highlight names a branch instead of a flattened index."""
+    from defer_tpu.cli import _render_monitor
+
+    def row(stage, **kw):
+        d = {"stage": stage, "replica": None, "branch": None,
+             "join": None, "tier": "tcp", "alive": True, "addr": "a:1",
+             "infer_ms": {"p50": 1.0, "p95": 2.0, "p99": 3.0},
+             "throughput_per_s": 10.0, "rx_q": 0, "tx_q": 0,
+             "rx_hi": 0, "tx_hi": 0, "inflight": 0,
+             "rx_bytes_per_s": 0.0, "tx_bytes_per_s": 0.0,
+             "processed": 5}
+        d.update(kw)
+        return d
+
+    rows = [row(0), row(1, branch=1), row(2, branch=2),
+            row(3, join=3)]
+    _render_monitor(rows, 1, [], {}, clear=False)
+    out = capsys.readouterr().out
+    assert "BR" in out.splitlines()[0]
+    assert " b1 " in out and " b2 " in out and " j3 " in out
+    # the highlighted bottleneck row is the branch vertex (non-tty mode
+    # appends the marker instead of inverting)
+    marked = [ln for ln in out.splitlines() if "<- bottleneck" in ln]
+    assert len(marked) == 1 and " b1 " in marked[0]
+
+
+def test_cluster_rows_carry_branch_ident():
+    """ClusterView rows surface the node ident's branch/join fields —
+    what a live branched chain pushes (docs/OBSERVABILITY.md)."""
+    from defer_tpu.obs.cluster import ClusterView
+
+    view = ClusterView()
+    payload = {"node": {"stage": 2, "name": "g/stage2.b1", "replica": None,
+                        "branch": 1, "join": 0, "fan_in": 1, "port": 1,
+                        "codec": "raw", "tier": "tcp", "tier_in": None},
+               "processed": 3, "queues": {}, "latency": {},
+               "counters": {}}
+    view.ingest(payload, "127.0.0.1:1")
+    (r,) = view.rows()
+    assert r["branch"] == 1 and r["join"] == 0 and r["stage"] == 2
+
+
+def test_cli_oversubscribed_linear_names_merges(capsys):
+    from defer_tpu.cli import main
+    for argv in (["plan", "--model", "moe_branched_tiny",
+                  "--stages", "10"],
+                 ["partition", "--model", "moe_branched_tiny",
+                  "--stages", "10"]):
+        with pytest.raises(SystemExit) as ei:
+            main(argv)
+        msg = str(ei.value)
+        assert "moe_0" in msg and "moe_1" in msg and "--dag" in msg

@@ -42,6 +42,13 @@ def test_apply_matches_manual():
     np.testing.assert_allclose(np.asarray(y), np.asarray(manual), rtol=1e-6)
 
 
+def test_apply_requires_input_or_seeds():
+    g = diamond_graph()
+    params = g.init(jax.random.key(0))
+    with pytest.raises(TypeError, match="seeds"):
+        g.apply(params)
+
+
 def test_valid_cut_points_excludes_branch_interior():
     g = diamond_graph()
     cuts = valid_cut_points(g)
