@@ -300,12 +300,22 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: the SAME node count; the row also records the critical-path
 #: planner's predicted DAG-vs-linear bottlenecks on inception_tiny and
 #: the branched MoE family — docs/PLANNER.md)
+#: ... and `shm_fastpath` (shared-memory transport tier: the same
+#: codec-delay-bound 3-stage chain as REAL OS processes with every hop
+#: — dispatcher edges included — negotiated `shm` via the tier_probe
+#: handshake: activations cross a shared-memory ring while the socket
+#: is demoted to a doorbell; byte-identical to the all-TCP chain,
+#: >= 1.5x measured min-of-3 streams, zero codec.* samples on every
+#: stage's live channels, and no /dev/shm segment survives teardown —
+#: the same-host cross-PROCESS rung the colocated_fastpath row's
+#: `local` tier cannot reach)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
     "stage_replication": "replication_smoke.py",
     "obs_overhead": "monitor_smoke.py",
     "colocated_fastpath": "colocate_smoke.py",
+    "shm_fastpath": "shm_smoke.py",
     "serving_frontdoor": "serve_smoke.py",
     "dag_pipeline": "dag_smoke.py",
 }

@@ -113,10 +113,10 @@ def _add_cost_flags(p):
                         "instead of using analytic defaults")
     p.add_argument("--hop-tier-map", default="", metavar="CUT=TIER,...",
                    help="declare colocated boundaries to the cost model "
-                        "(cut node name = local|device): those hops are "
-                        "scored on the tier pseudo-codec instead of the "
-                        "cheapest wire codec, so cut placement exploits "
-                        "colocation (docs/PLANNER.md)")
+                        "(cut node name = local|shm|device): those hops "
+                        "are scored on the tier pseudo-codec instead of "
+                        "the cheapest wire codec, so cut placement "
+                        "exploits colocation (docs/PLANNER.md)")
 
 
 def _parse_hop_tier_map(spec: str) -> dict | None:
@@ -126,9 +126,9 @@ def _parse_hop_tier_map(spec: str) -> dict | None:
         if not part:
             continue
         cut, sep, tier = part.rpartition("=")
-        if not sep or tier not in ("local", "device", "tcp"):
+        if not sep or tier not in ("local", "shm", "device", "tcp"):
             raise SystemExit(f"--hop-tier-map: {part!r} is not "
-                             f"CUT=local|device|tcp")
+                             f"CUT=local|shm|device|tcp")
         out[cut] = tier
     return out or None
 
@@ -636,8 +636,10 @@ def cmd_node(args):
     # colocated stages: every --co-stage boards this process as its own
     # serve thread — the hops between housemates negotiate the local
     # (zero-serialization in-memory) transport tier (docs/TRANSPORT.md)
+    accept = (args.tier != "tcp") if args.tier_accept == "auto" \
+        else args.tier_accept == "1"
     node = boot(args.artifact, args.listen, args.next, args.codec,
-                args.tier, args.tier != "tcp", True)
+                args.tier, accept, True)
     co = [boot(kv.get("artifact"), kv["listen"], kv.get("next"),
                kv.get("codec", "raw"), kv.get("tier", args.tier),
                kv["accept"] == "1" if "accept" in kv
@@ -890,7 +892,14 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
             br = f"j{r['join']}"
         else:
             br = "-"
-        tier = (r.get("tier") or "-")[:5]
+        # a "!" marks a DEGRADED hop (this node offered a colocated
+        # tier, fell back, and is STILL riding tcp) — distinguishable
+        # from a hop that rides tcp because nothing better was ever
+        # offered; a later successful renegotiation clears the mark
+        # even though the lifetime fallback count stays nonzero
+        tier = r.get("tier") or "-"
+        tier = tier[:4] + "!" \
+            if r.get("tier_fallbacks") and tier == "tcp" else tier[:5]
         p = r["infer_ms"]
         line = (f"{stage:>5} {br:>3} {rep:>3} {tier:>5} "
                 f"{r['throughput_per_s']:>8.1f} "
@@ -1414,13 +1423,22 @@ def main(argv=None):
                     help="serve this process's metrics registry as a "
                          "Prometheus scrape endpoint on PORT "
                          "(0 = ephemeral, printed to stderr)")
-    nd.add_argument("--tier", choices=["auto", "tcp"], default="auto",
-                    help="outbound transport-tier policy: auto offers "
-                         "the colocated zero-serialization fast path on "
-                         "the downstream dial (degrading to tcp across "
-                         "processes); tcp is the pure-wire escape hatch "
-                         "— never probe, refuse inbound offers "
-                         "(docs/TRANSPORT.md)")
+    nd.add_argument("--tier", choices=["auto", "shm", "tcp"],
+                    default="auto",
+                    help="outbound transport-tier policy: auto walks "
+                         "the tier ladder on the downstream dial — "
+                         "local (same process, zero copies) over shm "
+                         "(same host, shared-memory ring + socket "
+                         "doorbell) over tcp; shm offers only the "
+                         "shared-memory rung; tcp is the pure-wire "
+                         "escape hatch — never probe, refuse inbound "
+                         "offers (docs/TRANSPORT.md)")
+    nd.add_argument("--tier-accept", choices=["auto", "0", "1"],
+                    default="auto",
+                    help="grant inbound tier offers (default: auto = "
+                         "exactly when --tier is not tcp; a stage "
+                         "whose own outbound is tcp may still be the "
+                         "colocated-tier TARGET of its upstream)")
     nd.add_argument("--co-stage", action="append", default=[],
                     metavar="SPEC",
                     help="host an additional stage node in THIS process "
@@ -1459,18 +1477,22 @@ def main(argv=None):
     c.add_argument("--prom-port", type=int, default=None, metavar="PORT",
                    help="serve the dispatcher process's metrics "
                         "registry as a Prometheus scrape endpoint")
-    c.add_argument("--tier", choices=["auto", "tcp"], default="auto",
+    c.add_argument("--tier", choices=["auto", "shm", "tcp"],
+                   default="auto",
                    help="transport-tier policy for every hop: auto "
-                        "negotiates the colocated fast path where it "
-                        "holds (same process) and degrades to tcp "
-                        "elsewhere; tcp is the escape hatch — pure "
-                        "wire end to end (docs/TRANSPORT.md)")
+                        "negotiates the cheapest fabric per hop — "
+                        "local (same process) over shm (same host, "
+                        "shared-memory ring) over tcp; shm pins the "
+                        "shared-memory offer; tcp is the escape hatch "
+                        "— pure wire end to end (docs/TRANSPORT.md)")
     c.add_argument("--hop-tiers", default="", metavar="T0,T1,...",
                    help="per-inter-stage-hop tier list (len = stages-1, "
-                        "each tcp|auto|local|device): device FUSES the "
-                        "two stages into one jit program, local "
+                        "each tcp|auto|local|shm|device): device FUSES "
+                        "the two stages into one jit program, local "
                         "COLOCATES them in one OS process with an "
-                        "in-memory channel between them")
+                        "in-memory channel between them, shm keeps "
+                        "separate processes but hands activations "
+                        "through a shared-memory ring")
     c.add_argument("--dag", action="store_true",
                    help="deploy the DAG planner's branch-parallel stage "
                         "GRAPH instead of a linear chain: parallel "

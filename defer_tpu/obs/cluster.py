@@ -304,9 +304,13 @@ class ClusterView:
                 "join": node.ident.get("join"),
                 "name": node.ident.get("name"),
                 # negotiated OUTBOUND transport tier of the node's hop
-                # (tcp / local / auto-until-negotiated) — distinguishes
-                # wire-bound rows from colocated fast-path ones
+                # (tcp / local / shm / auto-until-negotiated) —
+                # distinguishes wire-bound rows from colocated
+                # fast-path ones — plus the hop's degraded-offer count
+                # (a tcp row with fallbacks is a hop that WANTED a
+                # colocated tier; the monitor marks it "tcp!")
                 "tier": node.ident.get("tier"),
+                "tier_fallbacks": node.ident.get("tier_fallbacks", 0),
                 "addr": node.addr,
                 "pushes": len(node.history),
                 "age_s": round(now - t_last, 3),

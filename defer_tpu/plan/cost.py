@@ -94,11 +94,19 @@ DEFAULT_CODECS: dict[str, CodecSpec] = {
 #:   (the array passes by reference), wire term = one memory-bandwidth
 #:   pass over the boundary bytes (the queue handoff's cache/allocator
 #:   cost — ``DEFAULT_LOCAL_BW_S``, override with ``local_bw_s=``).
+#: * ``shm`` — same host, separate processes, shared-memory ring
+#:   (``transport/shm.py``): zero encode/decode, wire term = TWO
+#:   memory-bandwidth passes over the boundary bytes (the write-in +
+#:   read-out memcpy pair) — costlier than ``local``, decades cheaper
+#:   than any TCP hop, so the ladder's preference order (local over
+#:   shm over tcp) falls out of the model.
 #: * ``device`` — the stages fuse into one jit program
 #:   (``partition.fuse_stages``): the hop does not exist; ~0 seconds.
 TIER_CODECS: dict[str, CodecSpec] = {
     "local": CodecSpec("local", ratio=1.0, encode_bytes_per_s=0.0,
                        decode_bytes_per_s=0.0),
+    "shm": CodecSpec("shm", ratio=1.0, encode_bytes_per_s=0.0,
+                     decode_bytes_per_s=0.0),
     "device": CodecSpec("device", ratio=1.0, encode_bytes_per_s=0.0,
                         decode_bytes_per_s=0.0),
 }
@@ -202,9 +210,9 @@ class StageCostModel:
     hop bandwidth in bytes/s; ``codecs`` the candidate
     :class:`CodecSpec` table per hop.
 
-    ``hop_tiers`` (cut name -> ``"local"``/``"device"``, anything
-    absent = ``"tcp"``) declares which boundaries the deployment
-    colocates: those hops cost their :data:`TIER_CODECS` pseudo-codec
+    ``hop_tiers`` (cut name -> ``"local"``/``"shm"``/``"device"``,
+    anything absent = ``"tcp"``) declares which boundaries the
+    deployment colocates: those hops cost their :data:`TIER_CODECS` pseudo-codec
     instead of the cheapest wire codec, so cut placement EXPLOITS
     colocation (a fat boundary is free to cross on a fused hop) instead
     of modeling every boundary as a TCP hop.  ``local_bw_s`` sets the
@@ -310,11 +318,14 @@ class StageCostModel:
                     ) -> tuple[float, float, float]:
         """(encode, wire, decode) seconds of a colocated hop: zero codec
         work on both sides; ``local`` pays one memory-bandwidth pass
-        over the boundary bytes, ``device`` (a fused program) nothing."""
+        over the boundary bytes, ``shm`` two (the ring's write-in +
+        read-out memcpy pair), ``device`` (a fused program) nothing."""
         if tier == "device":
             return 0.0, 0.0, 0.0
-        return TIER_CODECS["local"].comm_parts(self.cut_bytes(cut),
-                                               self.local_bw_s)
+        n = self.cut_bytes(cut)
+        if tier == "shm":
+            n *= 2
+        return TIER_CODECS["local"].comm_parts(n, self.local_bw_s)
 
     def comm_seconds(self, cut: str, codec: str) -> float:
         if codec in TIER_CODECS:

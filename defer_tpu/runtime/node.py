@@ -122,18 +122,25 @@ class StageNode:
     #: how the DAG smoke makes branch compute delay-bound on 1 core)
     infer_delay_s: float = 0.0
     next_hops: list[tuple[str, int]] | None = None
-    #: outbound transport-tier policy (docs/TRANSPORT.md): "auto" offers
-    #: the colocated fast path on the downstream dial (a tier_probe
-    #: handshake that silently degrades to tcp when the peer is another
-    #: process); "tcp" never probes — the status-quo wire path
+    #: outbound transport-tier policy (docs/TRANSPORT.md): "auto" walks
+    #: the tier ladder on the downstream dial — local (same process)
+    #: over shm (same host, shared-memory ring) over tcp — via
+    #: tier_probe handshakes that silently degrade when a rung's proof
+    #: fails; "shm" offers only the shared-memory tier; "tcp" never
+    #: probes — the status-quo wire path
     tier: str = "tcp"
     #: answer inbound tier probes (False = refuse every offer: the hop
     #: degrades to tcp with the sender's fallback counter bumped)
     tier_accept: bool = True
-    #: negotiated tiers, for stats/obs ("local"/"tcp"; None = no data
-    #: path yet)
+    #: negotiated tiers, for stats/obs ("local"/"shm"/"tcp"; None = no
+    #: data path yet)
     tier_out: str | None = None
     tier_in: str | None = None
+    #: outbound hops that WANTED a colocated tier but degraded to tcp —
+    #: the per-hop twin of the process-global
+    #: ``transport.tier_fallback`` counter (a shared count cannot tell a
+    #: degraded hop from a never-offered one)
+    tier_fallbacks: int = 0
     #: waterfall sampling period carried by the trace context (0 = every
     #: frame records spans, N >= 1 = only wire-seq multiples of N)
     trace_sample_every: int = 0
@@ -193,12 +200,14 @@ class StageNode:
                              "replica fan-in (the two merges own "
                              "different sequence namespaces)")
         self.infer_delay_s = max(0.0, float(infer_delay_s))
-        if tier not in ("tcp", "auto"):
-            raise ValueError(f"tier must be tcp|auto, got {tier!r}")
+        if tier not in ("tcp", "auto", "shm"):
+            raise ValueError(f"tier must be tcp|auto|shm, got {tier!r}")
         self.tier = tier
         self.tier_accept = tier_accept
         self.tier_out = None
         self.tier_in = None
+        self.tier_fallbacks = 0
+        self._check_tier_pin()
         self.processed = 0    # tensors relayed, lifetime
         self.reweights = 0    # weights-only re-pushes accepted
         #: trace-context K_CTRL received from upstream, held until this
@@ -247,6 +256,26 @@ class StageNode:
             return f"{base}.b{self.branch}"
         return base
 
+    def _check_tier_pin(self) -> None:
+        """Reject an explicit ``tier="shm"`` pin on a node whose hop
+        rides the ordered fan machinery (replica into a fan-in merge,
+        labeled branch into a join, fan-out next hops) — those paths
+        are wire-framed by design, so :meth:`_make_tx` would silently
+        skip the offer and run full codec + TCP under a tier claim
+        with ``tier_fallbacks`` still 0.  Mirrors the chain-level
+        ``hop_tiers`` adjacency guard; ``auto`` stays allowed (riding
+        tcp there is policy, not degradation)."""
+        if self.tier != "shm":
+            return
+        role = ("replica" if self.replica is not None
+                else "branch" if self.branch is not None
+                else "fan-out" if self.next_hops
+                and len(self.next_hops) > 1 else None)
+        if role is not None:
+            raise ValueError(
+                f"tier 'shm' pinned on a {role} node; fan paths ride "
+                f"tcp (drop the replicas/branching or the tier pin)")
+
     def _make_tx(self, connect_timeout_s: float):
         """Open the downstream connection(s): one :class:`AsyncSender`,
         or a :class:`FanOutSender` round-robining across a replicated
@@ -254,28 +283,33 @@ class StageNode:
         frame so even a replica that ends up with zero frames knows it
         is on the data path).
 
-        With ``tier="auto"`` a single (non-fan) hop first offers the
-        colocated fast path (``transport.local.offer_local``): granted,
-        frames ride an in-memory :class:`LocalPipe` with zero
-        serialization and the socket stays open only as the hop's
-        lifetime anchor; refused, the hop degrades to the status-quo
-        wire path.  Fan-out and replica dial-backs never probe — the
-        ordered fan machinery is wire-framed by design."""
+        With ``tier="auto"`` a single (non-fan) hop walks the tier
+        ladder (``transport.shm.offer_tier_ladder``, shared with the
+        dispatcher's edges): first the colocated fast path (same
+        process, zero copies), then the shared-memory tier (same host,
+        payload through a shm ring with the socket demoted to a
+        doorbell); ``tier="shm"`` offers only the shm rung.  Any
+        rung granted keeps the socket open as the hop's lifetime
+        anchor; all refused, the hop degrades to the status-quo wire
+        path with this hop's fallback counted once.  Fan-out and
+        replica dial-backs never probe — the ordered fan machinery is
+        wire-framed by design."""
         if not self.next_hops:
             raise ValueError("no next hop configured")
         socks = [_connect_retry(*h, timeout_s=connect_timeout_s)
                  for h in self.next_hops]
         if len(socks) == 1:
             tx = None
-            if self.tier == "auto" and self.replica is None \
+            if self.tier != "tcp" and self.replica is None \
                     and self.branch is None:
                 # branch-path hops never probe: the join end is wire-
                 # framed by design (ordered (path, seq) merge)
-                from ..transport.local import offer_local
-                self.tier_out, pipe = offer_local(socks[0],
-                                                  depth=self.tx_depth)
-                if pipe is not None:
-                    tx = pipe.sender
+                from ..transport.shm import offer_tier_ladder
+                self.tier_out, tx, fell_back = offer_tier_ladder(
+                    socks[0], tier=self.tier, depth=self.tx_depth,
+                    hop=self._span_label())
+                if fell_back:
+                    self.tier_fallbacks += 1
             if tx is None:
                 self.tier_out = "tcp"
                 tx = AsyncSender(socks[0], depth=self.tx_depth,
@@ -401,12 +435,13 @@ class StageNode:
             if msg.get("tier"):
                 # outbound transport-tier policy rides the deploy
                 # handshake, like the hop codec
-                if msg["tier"] not in ("tcp", "auto"):
-                    raise ValueError(f"deploy: tier must be tcp|auto, "
-                                     f"got {msg['tier']!r}")
+                if msg["tier"] not in ("tcp", "auto", "shm"):
+                    raise ValueError(f"deploy: tier must be "
+                                     f"tcp|auto|shm, got {msg['tier']!r}")
                 self.tier = msg["tier"]
             if msg.get("tier_accept") is not None:
                 self.tier_accept = bool(msg["tier_accept"])
+            self._check_tier_pin()
             send_ack(conn)
             return True
         if cmd == "reweight":
@@ -475,10 +510,12 @@ class StageNode:
                 "processed": self.processed,
                 "reweights": self.reweights,
                 "codec": self.codec,
-                # negotiated outbound transport tier ("local"/"tcp";
-                # the configured policy until a data path negotiates)
+                # negotiated outbound transport tier ("local"/"shm"/
+                # "tcp"; the configured policy until a data path
+                # negotiates) + this hop's degraded-offer count
                 "tier": self.tier_out or self.tier,
                 "tier_in": self.tier_in,
+                "tier_fallbacks": self.tier_fallbacks,
                 "next": None if not self.next_hops
                 else ",".join(f"{h}:{p}" for h, p in self.next_hops),
                 # wire telemetry: this node's process-local transport view
@@ -577,7 +614,8 @@ class StageNode:
                      "join": self.join_in, "fan_in": self.fan_in,
                      "port": self.address[1], "codec": self.codec,
                      "tier": self.tier_out or self.tier,
-                     "tier_in": self.tier_in},
+                     "tier_in": self.tier_in,
+                     "tier_fallbacks": self.tier_fallbacks},
             "processed": self.processed,
             "reweights": self.reweights,
             "counters": {
@@ -800,19 +838,20 @@ class StageNode:
                         continue
                     if isinstance(value, dict) \
                             and value.get("cmd") == "tier_probe":
-                        # colocated-tier handshake: granted, the data
-                        # path SWAPS to the offered in-memory pipe (the
-                        # socket stays as the hop's lifetime anchor);
-                        # refused, the stream continues on this socket
-                        from ..transport.local import answer_probe
-                        pipe = answer_probe(conn, value,
-                                            accept=self.tier_accept)
-                        if pipe is not None:
-                            rx = pipe.receiver
+                        # colocated-tier handshake: a local grant SWAPS
+                        # the data path to the offered in-memory pipe;
+                        # a shm grant wraps this socket channel into a
+                        # ShmReceiver (descriptors keep riding the
+                        # socket as the doorbell, payloads come out of
+                        # the mapped ring); refused, the stream
+                        # continues on this socket
+                        from ..transport.shm import answer_tier_probe
+                        self.tier_in, chan = answer_tier_probe(
+                            conn, value, accept=self.tier_accept,
+                            inner=rx, depth=self.rx_depth)
+                        if chan is not None:
+                            rx = chan
                             rx.sample_every = self.trace_sample_every
-                            self.tier_in = "local"
-                        else:
-                            self.tier_in = "tcp"
                         continue
                     if isinstance(value, dict) \
                             and value.get("cmd") == "req_meta":
@@ -1437,13 +1476,16 @@ class ChainDispatcher:
     rx_depth: int = 8
     result_fan_in: int = 1
     #: outbound tier policy for the dispatcher -> stage-0 hop ("auto"
-    #: offers the colocated fast path; "tcp" never) — also gates whether
-    #: the result server GRANTS the last node's inbound offer
+    #: walks the local-over-shm-over-tcp ladder; "shm" offers only the
+    #: shared-memory rung; "tcp" never probes) — also gates whether the
+    #: result server GRANTS the last node's inbound offer
     tier: str = "tcp"
     tier_accept: bool = True
     #: negotiated tiers for reporting (first hop / result hop)
     tier_out: str | None = None
     tier_in: str | None = None
+    #: first-hop offers that degraded to tcp (per-hop fallback twin)
+    tier_fallbacks: int = 0
     #: waterfall sampling period (docs/OBSERVABILITY.md): with tracing
     #: enabled and N >= 1, every tensor frame is stamped with its stream
     #: sequence number and only 1-in-N frames record per-frame spans —
@@ -1467,16 +1509,17 @@ class ChainDispatcher:
                  tier: str = "tcp", tier_accept: bool | None = None):
         if timeout_s is not None:
             self.timeout_s = timeout_s
-        if tier not in ("tcp", "auto"):
-            raise ValueError(f"tier must be tcp|auto, got {tier!r}")
+        if tier not in ("tcp", "auto", "shm"):
+            raise ValueError(f"tier must be tcp|auto|shm, got {tier!r}")
         self.tier = tier
         #: default: grant result-hop offers exactly when this dispatcher
         #: itself plays the colocated game ("--tier tcp" forces a pure
         #: wire chain end to end)
-        self.tier_accept = (tier == "auto") if tier_accept is None \
+        self.tier_accept = (tier != "tcp") if tier_accept is None \
             else tier_accept
         self.tier_out = None
         self.tier_in = None
+        self.tier_fallbacks = 0
         host, port = _parse_hostport(listen)
         self._res_srv = socket.create_server((host, port))
         # a dead chain fails, not hangs
@@ -1528,14 +1571,19 @@ class ChainDispatcher:
                                              hist="chain.tx_s")
                 self._tx_chan.send_ctrl({"cmd": "stream_begin"})
             else:
-                if self.tier == "auto":
-                    # offer the colocated fast path on the stage-0 hop;
-                    # a cross-process node refuses and we stay on tcp
-                    from ..transport.local import offer_local
-                    self.tier_out, pipe = offer_local(
-                        self._send_sock, depth=self.tx_depth)
-                    if pipe is not None:
-                        self._tx_chan = pipe.sender
+                if self.tier != "tcp":
+                    # tier ladder on the stage-0 hop: local (same
+                    # process) over shm (same host) over tcp; a
+                    # cross-host node refuses everything and we stay
+                    # on tcp with one fallback counted
+                    from ..transport.shm import offer_tier_ladder
+                    self.tier_out, self._tx_chan, fell_back = \
+                        offer_tier_ladder(self._send_sock,
+                                          tier=self.tier,
+                                          depth=self.tx_depth,
+                                          hop="chain")
+                    if fell_back:
+                        self.tier_fallbacks += 1
                 if self._tx_chan is None:
                     self.tier_out = "tcp"
                     self._tx_chan = AsyncSender(
@@ -1672,14 +1720,20 @@ class ChainDispatcher:
         Adjacent replicated stages are rejected — a replica cannot
         restore another fan-out's order.  ``codecs`` (per stage) sets
         each stage's OUTBOUND hop codec; default: this dispatcher's.
-        ``tiers`` (per stage, ``auto``/``tcp``) sets each stage's
-        OUTBOUND transport-tier policy the same way — the deploy-time
-        half of the tier handshake (docs/TRANSPORT.md): ``auto`` stages
-        offer the colocated fast path when they open their downstream
-        connection and silently degrade to tcp when the peer is another
-        process.
+        ``tiers`` (per stage, ``auto``/``shm``/``tcp``) sets each
+        stage's OUTBOUND transport-tier policy the same way — the
+        deploy-time half of the tier handshake (docs/TRANSPORT.md):
+        ``auto`` stages walk the local-over-shm-over-tcp ladder when
+        they open their downstream connection and silently degrade to
+        tcp when no rung's proof holds.
+
+        Deploying also sweeps ``/dev/shm`` for segments leaked by a
+        previous chain whose processes were killed ungracefully
+        (``transport.shm.sweep_orphan_segments``).
         """
+        from ..transport.shm import sweep_orphan_segments
         from ..utils.export import export_stage_bytes
+        sweep_orphan_segments()
         groups = [[a] if isinstance(a, str) else list(a)
                   for a in node_addrs]
         if len(groups) != len(stages):
@@ -1730,7 +1784,9 @@ class ChainDispatcher:
         sequence namespaces, and mixing them is rejected loudly at the
         node).  ``stage_delays`` (vid -> seconds) installs the bench-only
         simulated device time per vertex."""
+        from ..transport.shm import sweep_orphan_segments
         from ..utils.export import export_stage_bytes
+        sweep_orphan_segments()
         addrs = list(node_addrs)
         if len(addrs) != len(topology.vertices) or \
                 len(stages) != len(topology.vertices):
@@ -1830,22 +1886,29 @@ class ChainDispatcher:
             if kind == K_CTRL and isinstance(y, dict):
                 cmd = y.get("cmd")
                 if cmd == "tier_probe":
-                    # the last node offers the colocated fast path on its
-                    # result dial-back: granted, results swap to the
-                    # in-memory pipe (the socket stays as lifetime anchor)
-                    from ..transport.local import answer_probe
-                    pipe = answer_probe(self._res_conn, y,
-                                        accept=self.tier_accept)
-                    if pipe is not None:
+                    # the last node offers its fast path on the result
+                    # dial-back: a local grant swaps results to the
+                    # in-memory pipe (the socket stays as lifetime
+                    # anchor), a shm grant wraps the socket channel
+                    # into a ShmReceiver (the socket becomes the
+                    # doorbell)
+                    from ..transport.shm import answer_tier_probe
+                    self.tier_in, chan = answer_tier_probe(
+                        self._res_conn, y, accept=self.tier_accept,
+                        inner=self._rx_chan, depth=self.rx_depth)
+                    if self.tier_in == "local":
                         old = self._rx_chan
-                        self._rx_chan = pipe.receiver
+                        self._rx_chan = chan
                         self._rx_chan.sample_every = \
                             self.trace_sample_every
                         self._rx_chan.bind_gauge("chain.rx_queue_depth")
                         old.release_gauge()
-                        self.tier_in = "local"
-                    else:
-                        self.tier_in = "tcp"
+                    elif self.tier_in == "shm":
+                        # the inner channel stays live (doorbell source)
+                        # and keeps its gauge
+                        self._rx_chan = chan
+                        self._rx_chan.sample_every = \
+                            self.trace_sample_every
                     continue
                 if cmd in ("trace", "stream_begin"):
                     continue
@@ -2198,16 +2261,21 @@ def _normalize_hop_tiers(hop_tiers, n: int, r_of: list[int],
     stage — the ordered fan machinery is wire-framed by design, so a
     silent tcp downgrade there would belie the caller's topology."""
     if hop_tiers is None:
-        return [default] * max(0, n - 1)
-    tiers = [str(t) for t in hop_tiers]
+        # a global default still goes through the adjacency checks: a
+        # chain-wide tier="shm" pin with a replicated stage must fail
+        # as loudly as the equivalent explicit hop_tiers entry
+        tiers = [default] * max(0, n - 1)
+    else:
+        tiers = [str(t) for t in hop_tiers]
     if len(tiers) != n - 1:
         raise ValueError(f"hop_tiers must have one entry per inter-stage "
                          f"hop ({n - 1}), got {len(tiers)}")
     for k, t in enumerate(tiers):
-        if t not in ("tcp", "auto", "local", "device"):
+        if t not in ("tcp", "auto", "local", "shm", "device"):
             raise ValueError(f"hop_tiers[{k}] = {t!r}; "
-                             f"use tcp|auto|local|device")
-        if t in ("local", "device") and (r_of[k] > 1 or r_of[k + 1] > 1):
+                             f"use tcp|auto|local|shm|device")
+        if t in ("local", "shm", "device") \
+                and (r_of[k] > 1 or r_of[k + 1] > 1):
             raise ValueError(
                 f"hop_tiers[{k}] = {t!r} but stage {k} or {k + 1} is "
                 f"replicated; fan paths ride tcp (drop the replicas or "
@@ -2268,8 +2336,16 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
       ``--co-stage`` serve thread) and the hop negotiates the
       zero-serialization in-memory channel.  A handshake that fails
       anyway degrades to tcp and bumps ``transport.tier_fallback``.
-    * ``"auto"`` — separate processes; the hop still offers the fast
-      path at connect time (it will degrade to tcp cross-process).
+    * ``"shm"`` — same host, separate OS processes: the hop's payload
+      crosses a ``multiprocessing.shared_memory`` ring (one memcpy per
+      side, no codec, no socket bytes) while the TCP socket is demoted
+      to a per-frame doorbell carrying seq/ctrl/END ordering
+      (``transport/shm.py``).  A failed handshake (cross-host peer,
+      refusal) degrades to tcp the same way.
+    * ``"auto"`` — separate processes; the hop walks the
+      local-over-shm-over-tcp ladder at connect time, so the standard
+      same-host multi-process chain negotiates shm everywhere without
+      being asked.
     * ``"tcp"`` — the status-quo wire path, no probe.
 
     Neither side of a ``device``/``local`` hop may be replicated (the
@@ -2308,8 +2384,12 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     multi-host deployments run ``python -m defer_tpu node`` per host with
     each host's own accelerator environment instead.
     """
+    from ..transport.shm import sweep_orphan_segments
     from ..utils.export import export_pipeline
 
+    # reap /dev/shm segments leaked by a previous chain whose processes
+    # were all killed ungracefully (kill -9 skips every unlink path)
+    sweep_orphan_segments()
     tmp = None
     if artifact_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="defer_chain_")
@@ -2333,18 +2413,19 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                 f"({n}), got {len(stage_delays)}")
         delay_of = [float(d) for d in stage_delays] \
             if stage_delays is not None else [0.0] * n
-        if tier not in ("tcp", "auto"):
-            raise ValueError(f"tier must be tcp|auto, got {tier!r}")
+        if tier not in ("tcp", "auto", "shm"):
+            raise ValueError(f"tier must be tcp|auto|shm, got {tier!r}")
         tiers = _normalize_hop_tiers(hop_tiers, n, r_of, tier)
-        if not overlap and any(t == "local" for t in tiers):
+        claimed = [t for t in tiers if t in ("local", "shm")]
+        if not overlap and claimed:
             # the serial baseline loop is pure-wire by design and always
-            # refuses tier offers — an EXPLICIT local claim would
-            # silently run full codec + TCP inside one process, so
+            # refuses tier offers — an EXPLICIT local/shm claim would
+            # silently run full codec + TCP under a tier claim, so
             # reject loudly (same rule as replicated colocated hops);
             # "auto" offers still degrade cleanly under --no-overlap
             raise ValueError(
-                "hop_tiers 'local' requires the overlapped node loop "
-                "(drop overlap=False / --no-overlap)")
+                f"hop_tiers {claimed[0]!r} requires the overlapped node "
+                f"loop (drop overlap=False / --no-overlap)")
         if any(t == "device" for t in tiers):
             # fuse every device-tier hop: adjacent stages become ONE
             # jit-compiled stage program and the hop ceases to exist
@@ -2364,8 +2445,12 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
             else:
                 coloc.append([k + 1])
         #: per-stage OUTBOUND tier policy argv ("local" claims ride the
-        #: same auto probe — colocation is what makes them succeed)
-        tier_of = [("auto" if tiers[k] in ("auto", "local") else "tcp")
+        #: same auto probe — colocation is what makes them succeed;
+        #: "shm" claims pin the shm-only offer: the stages stay in
+        #: separate OS processes and the payload crosses the shared-
+        #: memory ring)
+        tier_of = [("auto" if tiers[k] in ("auto", "local")
+                    else "shm" if tiers[k] == "shm" else "tcp")
                    for k in range(n - 1)] + [tier]
 
         child_env = dict(os.environ)
@@ -2500,6 +2585,13 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             return []
         flags = ["--artifact", paths[k], "--next", next_of(k),
                  "--codec", codec_of[k], "--tier", tier_of[k]]
+        if k > 0 and tier_of[k - 1] != "tcp" and tier_of[k] == "tcp":
+            # the INBOUND hop claims a colocated tier but this stage's
+            # own outbound policy is tcp: grant inbound offers anyway —
+            # acceptance follows the upstream's claim, not this stage's
+            # outbound (mixed maps like shm,tcp must not silently
+            # degrade hop k-1)
+            flags += ["--tier-accept", "1"]
         if k > 0 and r_of[k - 1] > 1:
             flags += ["--fan-in", str(r_of[k - 1])]
         if r_of[k] > 1:

@@ -3,6 +3,8 @@ from .framed import (K_BYTES, K_END, K_TENSOR, K_TENSOR_SEQ, TensorClient,
                      TensorServer, configure_socket, recv_frame, send_end,
                      send_frame)
 from .local import (LocalPipe, LocalReceiver, LocalSender, grant_local,
-                    offer_local)
+                    offer_local, record_fallback)
+from .shm import (ShmReceiver, ShmRing, ShmSender, grant_shm, offer_shm,
+                  offer_tier_ladder, sweep_orphan_segments)
 from .branch import BranchJoin, BroadcastSender
 from .replicate import FanInMerge, FanOutSender

@@ -58,11 +58,23 @@ from .framed import (K_CTRL, K_END, K_TENSOR, K_TENSOR_SEQ,
                      PROTOCOL_VERSION, recv_expect, send_ctrl)
 
 __all__ = ["LocalPipe", "LocalReceiver", "LocalSender", "grant_local",
-           "offer_local"]
+           "offer_local", "record_fallback"]
 
 #: hops that wanted a colocated tier but degraded to tcp (failed
 #: handshake: wrong pid, version mismatch, unknown token, refused peer)
 _FALLBACK = REGISTRY.counter("transport.tier_fallback")
+
+
+def record_fallback(hop: str | None = None) -> None:
+    """Count one degraded hop: the process-global
+    ``transport.tier_fallback`` counter plus — when the offering side
+    named its hop (stage/cut ident, e.g. ``stage1.r0`` or ``chain``) —
+    a per-hop labeled ``transport.tier_fallback.<hop>`` twin, so a
+    silent tcp fallback is attributable to the hop that degraded
+    instead of one anonymous process-wide count."""
+    _FALLBACK.n += 1
+    if hop:
+        REGISTRY.counter(f"transport.tier_fallback.{hop}").n += 1
 #: tensor frames handed through local pipes (the colocated analogue of
 #: ``transport.tx_frames`` — which local hops must NOT touch, so frame
 #: counters keep meaning "bytes that crossed a wire")
@@ -300,7 +312,8 @@ def _claim(token) -> LocalPipe | None:
         return _OFFERS.pop(token, None)
 
 
-def offer_local(sock, *, depth: int = 8) -> tuple[str, LocalPipe | None]:
+def offer_local(sock, *, depth: int = 8, hop: str | None = None,
+                fallback: bool = True) -> tuple[str, LocalPipe | None]:
     """Offer the colocated fast path on a freshly dialed data socket.
 
     Sends the ``tier_probe`` control frame and synchronously awaits the
@@ -309,8 +322,12 @@ def offer_local(sock, *, depth: int = 8) -> tuple[str, LocalPipe | None]:
     ``("local", pipe)`` when granted — the caller sends all further
     frames through ``pipe.sender`` and keeps the socket only as the
     connection's lifetime anchor — or ``("tcp", None)`` after a refusal,
-    bumping ``transport.tier_fallback``: the hop silently degrades to
-    the status-quo wire path on the same socket.
+    bumping ``transport.tier_fallback`` (labeled per ``hop`` — see
+    :func:`record_fallback`): the hop silently degrades to the
+    status-quo wire path on the same socket.  ``fallback=False``
+    suppresses the count — for callers that will offer the NEXT rung of
+    the tier ladder (shm) on the same socket, so one degraded hop never
+    counts twice.
     """
     pipe = LocalPipe(depth=depth)
     token = _register(pipe)
@@ -324,7 +341,8 @@ def offer_local(sock, *, depth: int = 8) -> tuple[str, LocalPipe | None]:
     if isinstance(reply, dict) and reply.get("cmd") == "tier_reply" \
             and reply.get("tier") == "local":
         return "local", pipe
-    _FALLBACK.n += 1
+    if fallback:
+        record_fallback(hop)
     return "tcp", None
 
 

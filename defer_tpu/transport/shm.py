@@ -1,0 +1,679 @@
+"""Shared-memory transport tier: zero-copy same-host cross-process hops.
+
+The ``local`` tier (``transport/local.py``) only engages when both hop
+endpoints share one PROCESS; the repo's standard proof mode — and any
+real deployment packing several stages per host — runs stages as
+separate OS processes on one machine, where every activation still
+crossed TCP loopback with a full codec pass.  This module is the
+missing rung between ``local`` and ``tcp``: activations ride a
+``multiprocessing.shared_memory`` ring of tensor slots, and the TCP
+socket the hop dialed anyway is demoted to a tiny DOORBELL carrying
+per-frame slot descriptors, control frames, and END — so seq stamping,
+in-order K_CTRL, and the cascading END keep wire-protocol-v2 semantics
+verbatim while the payload bytes never touch a socket.
+
+* :class:`ShmRing` — one shared segment of ``slots`` fixed-capacity
+  slots, created by the sender.  A tensor is written ONCE into the next
+  free slot (``memoryview`` assignment — one memcpy, no codec, no
+  framing) and announced with a ``shm_frame`` doorbell K_CTRL naming
+  the slot, dtype, shape, and optional seq; the receiver maps the slot
+  as an ``np.frombuffer`` view and materializes the (exclusively
+  owned) array with one memcpy out — zero serialization, and no copy
+  beyond the unavoidable write-in/read-out pair.  A frame fatter than
+  the slot capacity GROWS the ring: the sender drains outstanding
+  slots, swaps in a bigger segment, and announces it with a
+  ``shm_grow`` doorbell that — riding the ordered socket — always
+  arrives before any frame referencing it.
+* **Backpressure** — the ring is bounded: the receiver returns one ack
+  byte on the doorbell socket per consumed frame (slots are used and
+  freed in FIFO order, so a count is enough), and a full ring parks
+  the producer exactly like a full ``AsyncSender`` queue.  Peer death
+  poisons both ends: socket EOF fails the receiver's frame source with
+  ``ConnectionError``, and the sender's ack reader marks the channel
+  dead so a parked producer wakes with :class:`ChannelError`.
+* **Negotiation** — the sender creates the segment, then offers
+  ``{"cmd": "tier_probe", "want": "shm", seg, boot_id, proto}`` on the
+  freshly dialed socket.  The grantor accepts only when the protocol
+  version matches, the boot id matches, and it can ACTUALLY OPEN the
+  offered segment name — the open is the same-host proof, in the
+  spirit of the local tier's "the registry lookup IS the proof" (a
+  cross-host peer's ``/dev/shm`` name never resolves; the boot id
+  guards pathological name collisions).  Any failed check silently
+  degrades the hop to tcp on the same socket and bumps the
+  ``transport.tier_fallback`` counter (plus its per-hop labeled twin).
+
+Segment lifecycle: segments are named ``defer_shm_<pid>_<rand>`` so an
+orphan is attributable.  The creating process unlinks on close/detach
+and again from an ``atexit`` hook; the receiver also unlinks on its
+teardown (mapped frames stay readable after unlink, so this is safe
+mid-stream) — whichever end survives a crash reaps the segment.  When
+BOTH ends die ungracefully (kill -9), :func:`sweep_orphan_segments` —
+run by the dispatcher at deploy — unlinks any ``defer_shm_`` segment
+whose creator pid is no longer alive, so a murdered chain never leaks
+``/dev/shm``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+import uuid
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ..obs import REGISTRY, LatencyHistogram
+from .channel import ChannelError
+from .framed import (K_CTRL, K_TENSOR, K_TENSOR_SEQ, PROTOCOL_VERSION,
+                     recv_expect, send_ctrl, send_end)
+from .local import record_fallback
+
+__all__ = ["ShmReceiver", "ShmRing", "ShmSender", "answer_tier_probe",
+           "grant_shm", "offer_shm", "sweep_orphan_segments"]
+
+#: tensor frames handed through shm rings (the same-host analogue of
+#: ``transport.local_frames`` — wire frame counters keep meaning "bytes
+#: that crossed a socket", which shm payloads never do)
+_SHM_FRAMES = REGISTRY.counter("transport.shm_frames")
+
+#: segment name prefix: ``defer_shm_<creator pid>_<rand>`` — the pid is
+#: what lets the orphan sweep attribute (and reap) a dead chain's leaks
+SEG_PREFIX = "defer_shm_"
+
+#: default slot capacity; a fatter first frame grows the ring in place
+DEFAULT_SLOT_BYTES = 1 << 20
+
+#: rings created by THIS process and not yet unlinked (atexit backstop)
+_LIVE_RINGS: "set[ShmRing]" = set()
+_LIVE_LOCK = threading.Lock()
+
+
+def _boot_id() -> str:
+    """This host's boot id — the cheap same-host witness carried by the
+    probe (the segment OPEN is the real proof; this guards name
+    collisions across hosts that share a /dev/shm-like namespace)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        import socket as _socket
+        return f"host:{_socket.gethostname()}"
+
+
+def _untrack(seg: shared_memory.SharedMemory) -> None:
+    """Detach ``seg`` from multiprocessing's resource tracker: this
+    module owns the unlink discipline (explicit + atexit + the deploy
+    sweep), and the tracker double-managing the name leads to
+    unregister races and bogus leak warnings on Python < 3.13."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:  # noqa: BLE001 — tracking is best-effort anyway
+        pass
+
+
+def _unlink_name(name: str) -> None:
+    """Remove a segment NAME without touching the resource tracker
+    (``SharedMemory.unlink`` unregisters internally, which double-faults
+    after :func:`_untrack` already detached the name).  Idempotent."""
+    try:
+        import _posixshmem
+        _posixshmem.shm_unlink("/" + name)
+    except ImportError:
+        try:
+            os.unlink(os.path.join("/dev/shm", name))
+        except OSError:
+            pass
+    except (OSError, FileNotFoundError):
+        pass
+
+
+def _open_segment(name: str) -> shared_memory.SharedMemory | None:
+    """Map an existing segment by name, untracked; None if it does not
+    resolve on this host (the grantor's refusal path)."""
+    try:
+        seg = shared_memory.SharedMemory(name=name)
+    except (OSError, ValueError):
+        return None
+    _untrack(seg)
+    return seg
+
+
+@atexit.register
+def _unlink_live_rings() -> None:
+    with _LIVE_LOCK:
+        rings = list(_LIVE_RINGS)
+    for r in rings:
+        r.unlink()
+
+
+class ShmRing:
+    """Sender-owned shared segment of ``slots`` fixed-capacity slots.
+
+    Slots are claimed in FIFO ring order by the sender and freed in the
+    same order by the receiver's acks, so the free-slot accounting is a
+    plain counting semaphore — no per-slot state crosses the processes
+    beyond the doorbell descriptor.
+    """
+
+    def __init__(self, *, slots: int = 8,
+                 slot_bytes: int = DEFAULT_SLOT_BYTES):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        # 64-byte slot alignment keeps every np.frombuffer offset legal
+        # for any real dtype
+        self.slot_bytes = max(64, (int(slot_bytes) + 63) & ~63)
+        self.slots = slots
+        self.name = f"{SEG_PREFIX}{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        self._seg = shared_memory.SharedMemory(
+            name=self.name, create=True, size=self.slots * self.slot_bytes)
+        _untrack(self._seg)
+        self._unlinked = False
+        with _LIVE_LOCK:
+            _LIVE_RINGS.add(self)
+
+    @property
+    def buf(self):
+        return self._seg.buf
+
+    def write(self, slot: int, data: memoryview) -> None:
+        off = slot * self.slot_bytes
+        self._seg.buf[off:off + data.nbytes] = data
+
+    def unlink(self) -> None:
+        """Remove the segment name (idempotent; existing mappings stay
+        valid).  Both ends call this on teardown — whoever survives a
+        crash reaps the name, and the double call is harmless."""
+        if self._unlinked:
+            return
+        self._unlinked = True
+        with _LIVE_LOCK:
+            _LIVE_RINGS.discard(self)
+        _unlink_name(self.name)  # the other end may have got there first
+
+    def close(self) -> None:
+        try:
+            self._seg.close()
+        except (OSError, BufferError):
+            pass
+
+
+class ShmSender:
+    """Producer end of a shm hop (AsyncSender surface).
+
+    ``send`` claims the next free slot (parking when the ring is full —
+    the bounded-backpressure contract), memcpys the tensor in, and
+    sends the doorbell descriptor; control frames and END ride the
+    doorbell socket directly, so their ordering relative to tensors is
+    the socket's FIFO — exactly the wire path's guarantee.
+    """
+
+    #: accepted for surface parity; shm hops record no per-frame rx/tx
+    #: spans (there is no encode/decode phase to time)
+    sample_every: int = 0
+    codec = "shm"   #: nominal; no codec ever runs on a shm hop
+
+    def __init__(self, sock, ring: ShmRing):
+        self._sock = sock
+        try:
+            # the dialed socket inherits connect_retry's 30 s timeout; a
+            # bare recv in the ack loop would hit it on any healthy-but-
+            # idle hop (no frames -> no acks) and falsely poison the
+            # channel — acks are events, not heartbeats
+            sock.settimeout(None)
+        except OSError:
+            pass
+        self._ring = ring
+        self.depth = ring.slots
+        #: per-channel encode histogram — stays empty (zero codec work)
+        self.enc = LatencyHistogram()
+        self.hi = 0
+        self.err: BaseException | None = None
+        self._ended = False
+        self._free = threading.Semaphore(ring.slots)
+        self._head = 0          # next slot index (FIFO ring order)
+        self._inflight = 0      # frames written, not yet acked
+        self._ilock = threading.Lock()
+        #: serializes doorbell socket writes (a trace ctrl from the
+        #: control path may race the stream thread's descriptors)
+        self._wlock = threading.Lock()
+        self._acks = threading.Thread(target=self._ack_loop, daemon=True,
+                                      name="shm-ack-rx")
+        self._acks.start()
+
+    # -- ack backchannel -----------------------------------------------------
+
+    def _ack_loop(self):
+        """Count ack bytes off the doorbell socket; EOF/error marks the
+        channel dead so a producer parked on a full ring wakes with
+        :class:`ChannelError` — the receiver-gone contract."""
+        try:
+            while True:
+                data = self._sock.recv(4096)
+                if not data:
+                    raise ConnectionError(
+                        "shm doorbell peer closed (receiver gone)")
+                with self._ilock:
+                    self._inflight -= len(data)
+                for _ in range(len(data)):
+                    self._free.release()
+        except BaseException as e:  # noqa: BLE001 — surfaced in send()
+            self.err = e
+
+    def _claim_slot(self, timeout: float | None = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.err is not None:
+                raise ChannelError("shm channel receiver gone") \
+                    from self.err
+            if self._free.acquire(timeout=0.05):
+                slot = self._head % self._ring.slots
+                self._head += 1
+                return slot
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shm ring full for {timeout:.1f}s "
+                    f"(peer stopped consuming)")
+
+    def _drain(self, timeout: float | None = None) -> None:
+        """Park until every written frame has been acked (ring empty)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.err is not None:
+                raise ChannelError("shm channel receiver gone") \
+                    from self.err
+            with self._ilock:
+                if self._inflight == 0:
+                    return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shm ring did not drain in {timeout:.1f}s")
+            time.sleep(0.002)
+
+    # -- producer side -------------------------------------------------------
+
+    def send(self, arr, *, seq: int | None = None) -> None:
+        arr = np.ascontiguousarray(np.asarray(arr))
+        if arr.nbytes > self._ring.slot_bytes:
+            self._grow(arr.nbytes)
+        slot = self._claim_slot()
+        self._ring.write(slot, memoryview(arr).cast("B"))
+        msg = {"cmd": "shm_frame", "slot": slot, "nbytes": arr.nbytes,
+               "dtype": arr.dtype.str, "shape": list(arr.shape)}
+        if seq is not None:
+            msg["seq"] = int(seq)
+        with self._ilock:
+            self._inflight += 1
+            if self._inflight > self.hi:
+                self.hi = self._inflight
+        with self._wlock:
+            send_ctrl(self._sock, msg)
+        _SHM_FRAMES.n += 1
+
+    def _grow(self, nbytes: int) -> None:
+        """Swap in a segment with bigger slots: drain the ring (the
+        receiver holds no copies — ``get`` materializes and acks), then
+        announce the new name on the ordered doorbell so it precedes
+        every frame that needs it."""
+        self._drain()
+        size = 1 << max(6, (int(nbytes) - 1).bit_length())
+        new = ShmRing(slots=self._ring.slots, slot_bytes=size)
+        with self._wlock:
+            send_ctrl(self._sock, {"cmd": "shm_grow", "seg": new.name,
+                                   "slots": new.slots,
+                                   "slot_bytes": new.slot_bytes})
+        old, self._ring = self._ring, new
+        self.depth = new.slots
+        old.unlink()
+        old.close()
+
+    def send_ctrl(self, msg: dict) -> None:
+        if self.err is not None:
+            raise ChannelError("shm channel receiver gone") from self.err
+        with self._wlock:
+            send_ctrl(self._sock, dict(msg))
+
+    def send_end(self) -> None:
+        with self._wlock:
+            send_end(self._sock)
+        self._ended = True
+
+    def close(self, timeout: float | None = None) -> None:
+        """Drain the ring, send END, release the segment name.  The
+        drain-first order means the receiver acks its last frame BEFORE
+        the END, so no ack can be in flight when the owner later closes
+        the socket (an unread ack at close would RST the doorbell under
+        the receiver's still-queued descriptors).  ``timeout`` bounds
+        the wait against a stalled-but-alive peer — dead chains fail,
+        not hang, matching ``AsyncSender.close``.  The segment name is
+        released whether or not the drain succeeds — a failed close
+        must not leak /dev/shm."""
+        try:
+            self._drain(timeout)
+            self.send_end()
+        finally:
+            self._ring.unlink()
+            self._ring.close()  # drop this end's mapping too, or a
+            #   long-lived node leaks one mapped ring per served stream
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Everything ``send`` accepted is already written and announced
+        (the doorbell sendall is synchronous); only surface a dead
+        peer, like ``LocalSender.flush``."""
+        if self.err is not None:
+            raise ChannelError("shm channel receiver gone") from self.err
+
+    def detach(self) -> None:
+        """Abandon the stream (owner teardown without an END): release
+        the segment name — the doorbell socket's close is what fails
+        the receiver, exactly like a cut TCP connection."""
+        if not self._ended:
+            self.err = self.err or ConnectionError(
+                "shm channel abandoned by sender")
+        self._ring.unlink()
+        self._ring.close()
+
+    def take_watermark(self) -> int:
+        with self._ilock:
+            h = max(self.hi, self._inflight)
+            self.hi = self._inflight
+        return h
+
+    def qsize(self) -> int:
+        with self._ilock:
+            return self._inflight
+
+
+class ShmReceiver:
+    """Consumer end of a shm hop (AsyncReceiver surface).
+
+    Wraps the hop's existing socket frame source (the
+    :class:`~defer_tpu.transport.channel.AsyncReceiver` whose rx thread
+    already owns the socket reads): ``shm_frame`` descriptors become
+    tensors read out of the mapped slot (one memcpy into an exclusively
+    owned array, then an immediate ack byte so the slot recycles);
+    every other frame kind passes through untouched, so ctrl ordering
+    and the cascading END are literally the wire path's.
+    """
+
+    sample_every: int = 0
+
+    def __init__(self, sock, inner, seg: shared_memory.SharedMemory, *,
+                 slot_bytes: int, slots: int):
+        self._sock = sock
+        self._inner = inner
+        self._seg = seg
+        self.slot_bytes = int(slot_bytes)
+        self.depth = int(slots)
+        #: per-channel decode histogram — stays empty (zero codec work)
+        self.dec = LatencyHistogram()
+        self.hi = 0
+        self.err: BaseException | None = None
+        self._closed = False
+
+    # -- frame source --------------------------------------------------------
+
+    def get(self, timeout: float | None = None) -> tuple:
+        while True:
+            try:
+                kind, value = self._inner.get(timeout)
+            except (ConnectionError, OSError):
+                self._teardown()
+                raise
+            item = self._translate(kind, value)
+            if item is not None:
+                return item
+
+    def get_nowait(self) -> tuple:
+        while True:
+            try:
+                kind, value = self._inner.get_nowait()
+            except (ConnectionError, OSError):
+                self._teardown()
+                raise
+            item = self._translate(kind, value)
+            if item is not None:
+                return item
+
+    def _translate(self, kind, value):
+        """shm doorbells -> tensors; ``shm_grow`` swaps the mapping and
+        yields nothing; everything else passes through."""
+        if kind != K_CTRL or not isinstance(value, dict):
+            return kind, value
+        cmd = value.get("cmd")
+        if cmd == "shm_frame":
+            arr = np.frombuffer(
+                self._seg.buf, dtype=np.dtype(value["dtype"]),
+                count=int(np.prod(value["shape"], dtype=np.int64))
+                if value["shape"] else 1,
+                offset=int(value["slot"]) * self.slot_bytes,
+            ).reshape(value["shape"]).copy()  # exclusively owned
+            try:
+                self._sock.sendall(b"\x01")  # slot recycles, FIFO order
+            except OSError as e:
+                self.err = e  # sender gone: surface on ITS next send
+            seq = value.get("seq")
+            if seq is not None:
+                return K_TENSOR_SEQ, (int(seq), arr)
+            return K_TENSOR, arr
+        if cmd == "shm_grow":
+            old, old_name = self._seg, self._seg.name
+            seg = _open_segment(value["seg"])
+            if seg is None:
+                raise ConnectionError(
+                    f"shm_grow named a segment this host cannot open: "
+                    f"{value['seg']!r}")
+            self._seg = seg
+            self.slot_bytes = int(value["slot_bytes"])
+            self.depth = int(value["slots"])
+            _unlink_name(old_name)  # sender also unlinks; harmless
+            old.close()
+            return None
+        return kind, value
+
+    def _teardown(self) -> None:
+        """Stream over (clean or poisoned): reap the segment name now
+        (the sender may be kill -9 dead — mapped data stays readable,
+        the NAME must not leak), drop the mapping, and SHUT DOWN the
+        doorbell socket — a plain close would not interrupt a peer
+        blocked in recv, so a producer parked on a full ring would
+        never learn this end is gone (the receiver-gone ->
+        ``ChannelError`` contract rides the shutdown's EOF)."""
+        if self._closed:
+            return
+        self._closed = True
+        _unlink_name(self._seg.name)
+        self._seg.close()
+        try:
+            import socket as _socket
+            self._sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass  # peer already gone / socket already closed
+
+    # -- AsyncReceiver surface parity ---------------------------------------
+
+    def bind_gauge(self, name: str) -> None:
+        self._inner.bind_gauge(name)
+
+    def bind_hist(self, name: str) -> None:
+        """Accepted for parity; a shm hop has no recv+decode phase to
+        time, so nothing is ever recorded under ``name``."""
+
+    def release_gauge(self) -> None:
+        """Stream over (clean or not): reconcile the inner channel's
+        gauge and release this end's segment mapping + name."""
+        self._inner.release_gauge()
+        self._teardown()
+
+    def take_watermark(self) -> int:
+        return self._inner.take_watermark()
+
+    def qsize(self) -> int:
+        return self._inner.qsize()
+
+
+# ---------------------------------------------------------------------------
+# negotiation
+# ---------------------------------------------------------------------------
+
+def offer_shm(sock, *, depth: int = 8,
+              slot_bytes: int = DEFAULT_SLOT_BYTES,
+              hop: str | None = None,
+              fallback: bool = True) -> tuple[str, ShmSender | None]:
+    """Offer the shared-memory tier on a freshly dialed data socket.
+
+    Creates the ring, sends the ``tier_probe`` (first frame on the
+    connection, so the reply cannot interleave with data), and awaits
+    the ``tier_reply``.  Granted: returns ``("shm", sender)`` — the
+    socket stays open as the hop's doorbell.  Refused (cross-host peer,
+    version mismatch, tcp-pinned peer): the ring is unlinked, the
+    ``transport.tier_fallback`` counter (and its per-``hop`` labeled
+    twin) is bumped when ``fallback``, and the hop silently degrades to
+    the status-quo wire path on the same socket."""
+    ring = ShmRing(slots=depth, slot_bytes=slot_bytes)
+    try:
+        send_ctrl(sock, {"cmd": "tier_probe", "want": "shm",
+                         "proto": PROTOCOL_VERSION, "boot_id": _boot_id(),
+                         "pid": os.getpid(), "seg": ring.name,
+                         "slots": ring.slots,
+                         "slot_bytes": ring.slot_bytes})
+        reply = recv_expect(sock, K_CTRL)
+    except BaseException:
+        ring.unlink()
+        ring.close()
+        raise
+    if isinstance(reply, dict) and reply.get("cmd") == "tier_reply" \
+            and reply.get("tier") == "shm":
+        return "shm", ShmSender(sock, ring)
+    ring.unlink()
+    ring.close()
+    if fallback:
+        record_fallback(hop)
+    return "tcp", None
+
+
+def offer_tier_ladder(sock, *, tier: str, depth: int = 8,
+                      hop: str | None = None):
+    """Walk the sender-side tier ladder on a freshly dialed data
+    socket: local (same process) over shm (same host) over tcp, one
+    probe per rung on the SAME socket.  ``tier="auto"`` offers every
+    rung; ``tier="shm"`` pins the shm-only offer.  Returns
+    ``(tier_out, tx_or_None, fell_back)`` — a granted rung's sender
+    (the socket stays open as the hop's lifetime anchor / doorbell), or
+    ``("tcp", None, True)`` when every offer was refused, with ONE
+    fallback recorded for the whole ladder (the local rung's refusal is
+    not yet a fallback while shm is still to be tried).  The single
+    place the ladder's rung order and fallback accounting live, shared
+    by stage hops and the dispatcher's first/result edges."""
+    from .local import offer_local
+    tx = None
+    tier_out = "tcp"
+    if tier == "auto":
+        tier_out, pipe = offer_local(sock, depth=depth, hop=hop,
+                                     fallback=False)
+        if pipe is not None:
+            tx = pipe.sender
+    if tx is None:
+        tier_out, tx = offer_shm(sock, depth=depth, hop=hop)
+    return tier_out, tx, tx is None
+
+
+def grant_shm(msg) -> shared_memory.SharedMemory | None:
+    """Validate one shm ``tier_probe``; return the OPENED segment when
+    the same-host claim holds, else None (caller replies ``tier_reply:
+    tcp`` and the hop degrades).
+
+    Checks, in order: the probe wants ``shm``; the wire protocol
+    version matches; the boot id is this host's; and the offered
+    segment name actually opens here — the open is the structural proof
+    both ends share one shared-memory namespace (a remote host's name
+    can never resolve, so a forged boot id alone is never enough)."""
+    if not isinstance(msg, dict) or msg.get("want") != "shm":
+        return None
+    try:
+        if int(msg.get("proto", -1)) != PROTOCOL_VERSION:
+            return None
+    except (TypeError, ValueError):
+        return None
+    if msg.get("boot_id") != _boot_id():
+        return None
+    if not isinstance(msg.get("seg"), str) \
+            or not msg["seg"].startswith(SEG_PREFIX):
+        return None
+    return _open_segment(msg["seg"])
+
+
+def answer_tier_probe(conn, msg, *, accept: bool = True, inner=None,
+                      depth: int = 8):
+    """Receiver-side handshake for EVERY colocated tier: validate
+    ``msg`` (when ``accept``), send the ``tier_reply`` on ``conn``, and
+    return ``(tier, receiver_or_None)`` — ``("local", LocalReceiver)``,
+    ``("shm", ShmReceiver)``, or ``("tcp", None)``.  ``inner`` is the
+    hop's live socket frame source (required to grant shm — the
+    doorbell rides it).  The one helper every granting serve loop uses
+    so a probe is ALWAYS answered; refusal-only loops keep
+    ``transport.local.answer_probe(..., accept=False)``, which refuses
+    any want."""
+    from .local import grant_local
+    want = msg.get("want") if isinstance(msg, dict) else None
+    if accept and want == "local":
+        pipe = grant_local(msg)
+        if pipe is not None:
+            send_ctrl(conn, {"cmd": "tier_reply", "tier": "local"})
+            return "local", pipe.receiver
+    elif accept and want == "shm" and inner is not None:
+        seg = grant_shm(msg)
+        if seg is not None:
+            # hand the proof's own mapping straight to the receiver
+            # (re-opening by name would race the sender's unlink paths)
+            rx = ShmReceiver(conn, inner, seg,
+                             slot_bytes=int(msg.get("slot_bytes",
+                                                    DEFAULT_SLOT_BYTES)),
+                             slots=int(msg.get("slots", depth)))
+            send_ctrl(conn, {"cmd": "tier_reply", "tier": "shm"})
+            return "shm", rx
+    send_ctrl(conn, {"cmd": "tier_reply", "tier": "tcp"})
+    return "tcp", None
+
+
+# ---------------------------------------------------------------------------
+# orphan sweep
+# ---------------------------------------------------------------------------
+
+def sweep_orphan_segments(shm_dir: str = "/dev/shm") -> list[str]:
+    """Unlink every ``defer_shm_<pid>_*`` segment whose creator pid is
+    dead — the deploy-time backstop for chains whose BOTH hop ends were
+    kill -9'd (either end surviving reaps its own segments inline).
+    Returns the reaped names.  No-op on hosts without a /dev/shm-style
+    directory."""
+    reaped: list[str] = []
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return reaped
+    for name in names:
+        if not name.startswith(SEG_PREFIX):
+            continue
+        rest = name[len(SEG_PREFIX):]
+        pid_s, _, _ = rest.partition("_")
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == os.getpid():
+            continue  # this process's rings reap themselves
+        try:
+            os.kill(pid, 0)
+            continue  # creator alive: the segment is (or may be) live
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue  # e.g. EPERM: alive under another uid — leave it
+        try:
+            os.unlink(os.path.join(shm_dir, name))
+            reaped.append(name)
+        except OSError:
+            pass
+    return reaped

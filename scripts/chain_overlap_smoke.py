@@ -167,11 +167,15 @@ def timed_chain(paths, xs_warm, xs, *, overlap: bool, codec: str,
     mode = "overlap" if overlap else "serial"
     procs, logs = [], []
     for i in range(n):
+        # --tier tcp pins the hops to the pure wire path: this row
+        # measures the rx/compute/tx OVERLAP, and an auto-negotiated
+        # shm hop would bypass the slow codec being overlapped
         argv = [sys.executable, "-m", "defer_tpu", "node",
                 "--artifact", paths[i],
                 "--listen", f"127.0.0.1:{ports[i]}",
                 "--next", f"127.0.0.1:{ports[i + 1]}",
-                "--codec", codec] + ([] if overlap else ["--no-overlap"])
+                "--codec", codec, "--tier", "tcp"] \
+            + ([] if overlap else ["--no-overlap"])
         lf = open(os.path.join(log_dir, f"{mode}_node_{i}.log"), "w+")
         logs.append(lf)
         procs.append(subprocess.Popen(argv, env=child_env, stdout=lf,

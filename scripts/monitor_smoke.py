@@ -123,9 +123,12 @@ def boot_procs(paths, codecs, *, log_dir, tag, sample=0) -> Chain:
     procs, logs = [], []
     for k in range(3):
         nxt = addrs[k + 1] if k < 2 else result
+        # --tier tcp: this row measures the OBSERVABILITY plane over a
+        # delay-bound wire chain; an auto-negotiated shm hop would
+        # bypass the dsleep/esleep codecs the straggler story rests on
         argv = [sys.executable, "-m", "defer_tpu", "node",
                 "--artifact", paths[k], "--listen", addrs[k],
-                "--next", nxt, "--codec", codecs[k]]
+                "--next", nxt, "--codec", codecs[k], "--tier", "tcp"]
         lf = open(os.path.join(log_dir, f"{tag}_node_{k}.log"), "w+")
         logs.append(lf)
         procs.append(subprocess.Popen(argv, env=child_env, stdout=lf,

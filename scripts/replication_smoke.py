@@ -225,19 +225,24 @@ def timed_chain(paths, xs_warm, xs, *, replicate: int, delay_ms: float,
     s2_addr = f"127.0.0.1:{ports[1 + r1]}"
     result = f"127.0.0.1:{ports[-1]}"
     mode = f"rep{r1}"
+    # --tier tcp everywhere: this row measures stage REPLICATION over
+    # the wire protocol; an auto-negotiated shm hop on the non-fan
+    # boundaries would bypass the dsleep/esleep codecs that make the
+    # middle stage the bottleneck
     argvs = [[sys.executable, "-m", "defer_tpu", "node",
               "--artifact", paths[0], "--listen", f"127.0.0.1:{ports[0]}",
-              "--next", ",".join(s1_addrs), "--codec", codecs[0]]]
+              "--next", ",".join(s1_addrs), "--codec", codecs[0],
+              "--tier", "tcp"]]
     for j in range(r1):
         argv = [sys.executable, "-m", "defer_tpu", "node",
                 "--artifact", paths[1], "--listen", s1_addrs[j],
-                "--next", s2_addr, "--codec", codecs[1]]
+                "--next", s2_addr, "--codec", codecs[1], "--tier", "tcp"]
         if r1 > 1:
             argv += ["--replica", str(j)]
         argvs.append(argv)
     argv = [sys.executable, "-m", "defer_tpu", "node",
             "--artifact", paths[2], "--listen", s2_addr,
-            "--next", result, "--codec", codecs[2]]
+            "--next", result, "--codec", codecs[2], "--tier", "tcp"]
     if r1 > 1:
         argv += ["--fan-in", str(r1)]
     argvs.append(argv)

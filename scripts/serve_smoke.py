@@ -134,9 +134,13 @@ def boot_door(stages, params, width, codecs, *, quick: bool,
         procs, logs = [], []
         for k in range(len(stages)):
             nxt = addrs[k + 1] if k + 1 < len(stages) else result
+            # --tier tcp: this row measures the serving front door over
+            # a delay-bound wire chain; an auto-negotiated shm hop
+            # would bypass the dsleep codec that makes it delay-bound
             argv = [sys.executable, "-m", "defer_tpu", "node",
                     "--artifact", paths[k], "--listen", addrs[k],
-                    "--next", nxt, "--codec", codecs[k]]
+                    "--next", nxt, "--codec", codecs[k],
+                    "--tier", "tcp"]
             lf = open(os.path.join(log_dir, f"{tag}_node{k}.log"), "w+")
             logs.append(lf)
             procs.append(subprocess.Popen(argv, env=env, stdout=lf,
