@@ -309,8 +309,21 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: stage's live channels, and no /dev/shm segment survives teardown —
 #: the same-host cross-PROCESS rung the colocated_fastpath row's
 #: `local` tier cannot reach)
+#: ... and `ici_fastpath` (device-resident transport tier: a copy-bound
+#: fat-activation 3-stage chain on a FORCED 4-device host mesh, every
+#: hop incl. dispatcher edges negotiated `ici` — live jax.Arrays cross
+#: the hops with ZERO host materialization (zero codec.* AND zero
+#: host_sync samples asserted; the one host sync per frame happens at
+#: the dispatcher's result edge) and the thin cross-device hop performs
+#: a real device-to-device jax.device_put per frame (distinct src/dst
+#: device ids asserted from stats); byte-identical to all-tcp /
+#: all-shm / all-local, >= 1.3x min-of-3 vs all-shm — the two REAL
+#: memcpys per hop per frame the device-resident path eliminates; the
+#: local tier is reported too but jax CPU host interop is zero-copy
+#: both ways, so ici ~= local on this vehicle by design)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
+    "ici_fastpath": "ici_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
     "stage_replication": "replication_smoke.py",
     "obs_overhead": "monitor_smoke.py",

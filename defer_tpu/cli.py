@@ -111,12 +111,17 @@ def _add_cost_flags(p):
     p.add_argument("--calibrate", action="store_true",
                    help="micro-bench the codec table on this host "
                         "instead of using analytic defaults")
+    p.add_argument("--ici-bw", type=float, default=0.0, metavar="BYTES_S",
+                   help="device-to-device interconnect bandwidth for "
+                        "ici-tier hops (default: the chip generation's "
+                        "one-way ICI figure, like --link-bw)")
     p.add_argument("--hop-tier-map", default="", metavar="CUT=TIER,...",
                    help="declare colocated boundaries to the cost model "
-                        "(cut node name = local|shm|device): those hops "
-                        "are scored on the tier pseudo-codec instead of "
-                        "the cheapest wire codec, so cut placement "
-                        "exploits colocation (docs/PLANNER.md)")
+                        "(cut node name = ici|local|shm|device): those "
+                        "hops are scored on the tier pseudo-codec "
+                        "instead of the cheapest wire codec, so cut "
+                        "placement exploits same-mesh colocation "
+                        "(docs/PLANNER.md)")
 
 
 def _parse_hop_tier_map(spec: str) -> dict | None:
@@ -126,9 +131,10 @@ def _parse_hop_tier_map(spec: str) -> dict | None:
         if not part:
             continue
         cut, sep, tier = part.rpartition("=")
-        if not sep or tier not in ("local", "shm", "device", "tcp"):
+        if not sep or tier not in ("ici", "local", "shm", "device",
+                                   "tcp"):
             raise SystemExit(f"--hop-tier-map: {part!r} is not "
-                             f"CUT=local|shm|device|tcp")
+                             f"CUT=ici|local|shm|device|tcp")
         out[cut] = tier
     return out or None
 
@@ -145,6 +151,7 @@ def _cost_model(args, graph, *, node_costs=None):
         codecs = {n: DEFAULT_CODECS[n] for n in names}
     return StageCostModel(graph, batch=getattr(args, "batch", 1),
                           link_bw_s=args.link_bw or None,
+                          ici_bw_s=getattr(args, "ici_bw", 0.0) or None,
                           codecs=codecs, node_costs=node_costs,
                           hop_tiers=_parse_hop_tier_map(
                               getattr(args, "hop_tier_map", "")))
@@ -578,12 +585,18 @@ def _parse_co_stage(spec: str) -> dict:
     if "listen" not in kv:
         raise SystemExit(f"--co-stage {spec!r} needs listen=host:port")
     bad = set(kv) - {"listen", "artifact", "next", "codec", "tier",
-                     "accept"}
+                     "accept", "device"}
     if bad:
         raise SystemExit(f"--co-stage: unknown keys {sorted(bad)}")
     if kv.get("accept") not in (None, "0", "1"):
         raise SystemExit(f"--co-stage: accept must be 0|1, "
                          f"got {kv['accept']!r}")
+    if "device" in kv:
+        try:
+            kv["device"] = int(kv["device"])
+        except ValueError:
+            raise SystemExit(f"--co-stage: device must be an integer "
+                             f"jax device index, got {kv['device']!r}")
     return kv
 
 
@@ -598,7 +611,8 @@ def cmd_node(args):
     _start_prom(args, "node")
     _codec(args.codec)  # loud at boot, not when the first tensor relays
 
-    def boot(artifact, listen, nxt, codec, tier, accept, primary):
+    def boot(artifact, listen, nxt, codec, tier, accept, primary,
+             device=None):
         # --fan-in/--replica (and the branch-graph roles --fan/--branch/
         # --join) describe the PRIMARY node's place in a fan topology;
         # housemates always sit on plain local hops (the fan machinery
@@ -615,7 +629,7 @@ def cmd_node(args):
                          join_in=args.join if primary else 0,
                          infer_delay_s=args.infer_delay_ms / 1e3
                          if primary else 0.0,
-                         tier=tier, tier_accept=accept)
+                         tier=tier, tier_accept=accept, device=device)
         what = (f"stage {node.manifest['index']} "
                 f"({node.manifest['name']})"
                 if node.manifest else "EMPTY (awaiting in-band deploy)")
@@ -639,11 +653,12 @@ def cmd_node(args):
     accept = (args.tier != "tcp") if args.tier_accept == "auto" \
         else args.tier_accept == "1"
     node = boot(args.artifact, args.listen, args.next, args.codec,
-                args.tier, accept, True)
+                args.tier, accept, True, args.device)
     co = [boot(kv.get("artifact"), kv["listen"], kv.get("next"),
                kv.get("codec", "raw"), kv.get("tier", args.tier),
                kv["accept"] == "1" if "accept" in kv
-               else kv.get("tier", args.tier) != "tcp", False)
+               else kv.get("tier", args.tier) != "tcp", False,
+               kv.get("device"))
           for kv in map(_parse_co_stage, args.co_stage or [])]
     counts: dict[int, int] = {}
 
@@ -674,8 +689,10 @@ def cmd_node(args):
     print(f"node: served {n} tensors; chain drained", file=sys.stderr)
 
 
-def _parse_replicas(spec: str) -> dict[int, int]:
-    """``stage1=2,stage3=3`` (or bare ``1=2,3=3``) -> {1: 2, 3: 3}."""
+def _parse_replicas(spec: str, flag: str = "--replicas") -> dict[int, int]:
+    """``stage1=2,stage3=3`` (or bare ``1=2,3=3``) -> {1: 2, 3: 3}.
+    Shared by ``--replicas`` (stage -> R) and ``--device-map``
+    (stage -> jax device index); ``flag`` names the error."""
     out: dict[int, int] = {}
     for part in (spec or "").split(","):
         part = part.strip()
@@ -683,14 +700,14 @@ def _parse_replicas(spec: str) -> dict[int, int]:
             continue
         k, _, v = part.partition("=")
         if not v:
-            raise SystemExit(f"--replicas: {part!r} is not stageK=R")
+            raise SystemExit(f"{flag}: {part!r} is not stageK=N")
         k = k.strip().lower()
         if k.startswith("stage"):
             k = k[len("stage"):]
         try:
             out[int(k)] = int(v)
         except ValueError:
-            raise SystemExit(f"--replicas: {part!r} is not stageK=R")
+            raise SystemExit(f"{flag}: {part!r} is not stageK=N")
     return out
 
 
@@ -818,6 +835,7 @@ def cmd_chain(args):
 
     replicas = _parse_replicas(args.replicas)
     hop_tiers = [t for t in args.hop_tiers.split(",") if t] or None
+    device_map = _parse_replicas(args.device_map, "--device-map") or None
     _start_prom(args, "chain")
     stats: list = []
     t0 = time.perf_counter()
@@ -826,6 +844,7 @@ def cmd_chain(args):
                      rx_depth=args.rx_depth, tx_depth=args.tx_depth,
                      inflight=args.inflight, replicas=replicas or None,
                      hop_tiers=hop_tiers, tier=args.tier,
+                     devices=args.devices, device_map=device_map,
                      stats_out=stats,
                      trace_sample_every=args.trace_sample)
     dt = time.perf_counter() - t0
@@ -877,7 +896,7 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
         print("\x1b[2J\x1b[H", end="")
     print(f"{'STAGE':>5} {'BR':>3} {'REP':>3} {'TIER':>5} {'INF/S':>8} "
           f"{'P50MS':>9} "
-          f"{'P95MS':>9} {'P99MS':>9} {'RXQ':>4} {'TXQ':>4} "
+          f"{'P95MS':>9} {'P99MS':>9} {'HS50':>7} {'RXQ':>4} {'TXQ':>4} "
           f"{'RX^':>4} {'TX^':>4} {'INF':>4} {'RX B/S':>11} "
           f"{'TX B/S':>11} {'DONE':>8}  ADDR")
     for r in rows:
@@ -901,9 +920,14 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
         tier = tier[:4] + "!" \
             if r.get("tier_fallbacks") and tier == "tcp" else tier[:5]
         p = r["infer_ms"]
+        # host-sync p50: "-" when the row recorded ZERO samples — an
+        # ici (device-resident) hop's proof mark
+        hs = r.get("host_sync_ms") or {}
+        hs50 = "-" if not hs.get("count") else f"{hs.get('p50', 0):.3f}"
         line = (f"{stage:>5} {br:>3} {rep:>3} {tier:>5} "
                 f"{r['throughput_per_s']:>8.1f} "
                 f"{p['p50']:>9.3f} {p['p95']:>9.3f} {p['p99']:>9.3f} "
+                f"{hs50:>7} "
                 f"{r['rx_q']:>4.0f} {r['tx_q']:>4.0f} "
                 f"{r['rx_hi']:>4.0f} {r['tx_hi']:>4.0f} "
                 f"{r['inflight']:>4.0f} {r['rx_bytes_per_s']:>11.0f} "
@@ -1423,16 +1447,26 @@ def main(argv=None):
                     help="serve this process's metrics registry as a "
                          "Prometheus scrape endpoint on PORT "
                          "(0 = ephemeral, printed to stderr)")
-    nd.add_argument("--tier", choices=["auto", "shm", "tcp"],
+    nd.add_argument("--tier",
+                    choices=["auto", "ici", "local", "shm", "tcp"],
                     default="auto",
                     help="outbound transport-tier policy: auto walks "
                          "the tier ladder on the downstream dial — "
-                         "local (same process, zero copies) over shm "
-                         "(same host, shared-memory ring + socket "
-                         "doorbell) over tcp; shm offers only the "
-                         "shared-memory rung; tcp is the pure-wire "
-                         "escape hatch — never probe, refuse inbound "
-                         "offers (docs/TRANSPORT.md)")
+                         "ici (same process + same mesh, live "
+                         "device-resident jax.Arrays) over local "
+                         "(same process, host ndarray by reference) "
+                         "over shm (same host, shared-memory ring + "
+                         "socket doorbell) over tcp; ici/local/shm "
+                         "pin that single rung's offer; tcp is the "
+                         "pure-wire escape hatch — never probe, "
+                         "refuse inbound offers (docs/TRANSPORT.md)")
+    nd.add_argument("--device", type=int, default=None, metavar="J",
+                    help="pin this node's stage program to jax device "
+                         "J (jax.devices()[J]): outputs stay resident "
+                         "there, and an upstream ici hop device_puts "
+                         "each activation onto it — force a multi-"
+                         "device host mesh with XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N")
     nd.add_argument("--tier-accept", choices=["auto", "0", "1"],
                     default="auto",
                     help="grant inbound tier offers (default: auto = "
@@ -1444,9 +1478,12 @@ def main(argv=None):
                     help="host an additional stage node in THIS process "
                          "(repeatable): 'listen=host:port[;artifact=P]"
                          "[;next=host:port][;codec=C][;tier=T]"
-                         "[;accept=0|1]' — hops between housemates "
-                         "negotiate the local in-memory tier (accept "
-                         "gates inbound offers; default: tier != tcp)")
+                         "[;accept=0|1][;device=J]' — hops between "
+                         "housemates negotiate the in-process tiers "
+                         "(ici when both sides share the mesh, local "
+                         "otherwise; accept gates inbound offers, "
+                         "default: tier != tcp; device pins the "
+                         "housemate's program to jax device J)")
     _add_overlap_flags(nd)
 
     c = sub.add_parser("chain", help="spawn a local N-process chain and "
@@ -1479,20 +1516,37 @@ def main(argv=None):
                         "registry as a Prometheus scrape endpoint")
     c.add_argument("--tier", choices=["auto", "shm", "tcp"],
                    default="auto",
-                   help="transport-tier policy for every hop: auto "
-                        "negotiates the cheapest fabric per hop — "
-                        "local (same process) over shm (same host, "
-                        "shared-memory ring) over tcp; shm pins the "
-                        "shared-memory offer; tcp is the escape hatch "
-                        "— pure wire end to end (docs/TRANSPORT.md)")
+                   help="transport-tier policy for every hop INCLUDING "
+                        "the dispatcher edges: auto negotiates the "
+                        "cheapest fabric per hop — ici (same process + "
+                        "same mesh, device-resident) over local (same "
+                        "process) over shm (same host, shared-memory "
+                        "ring) over tcp; shm pins the shared-memory "
+                        "offer; tcp is the escape hatch — pure wire "
+                        "end to end.  Pin ici/local on STAGE hops with "
+                        "--hop-tiers (the dispatcher is its own "
+                        "process, so those rungs cannot hold on its "
+                        "edges; docs/TRANSPORT.md)")
     c.add_argument("--hop-tiers", default="", metavar="T0,T1,...",
                    help="per-inter-stage-hop tier list (len = stages-1, "
-                        "each tcp|auto|local|shm|device): device FUSES "
-                        "the two stages into one jit program, local "
-                        "COLOCATES them in one OS process with an "
-                        "in-memory channel between them, shm keeps "
-                        "separate processes but hands activations "
-                        "through a shared-memory ring")
+                        "each tcp|auto|local|shm|ici|device): device "
+                        "FUSES the two stages into one jit program, "
+                        "ici COLOCATES them in one OS process and "
+                        "hands LIVE device-resident jax.Arrays across "
+                        "the hop (cross-device via one device_put), "
+                        "local colocates with a host-ndarray channel, "
+                        "shm keeps separate processes but hands "
+                        "activations through a shared-memory ring")
+    c.add_argument("--devices", type=int, default=None, metavar="N",
+                   help="force an N-device host mesh in every stage "
+                        "process (XLA_FLAGS "
+                        "--xla_force_host_platform_device_count=N) so "
+                        "--device-map can pin stages to distinct "
+                        "devices")
+    c.add_argument("--device-map", default="", metavar="stageK=J,...",
+                   help="pin stage K's program to jax device J — with "
+                        "ici hops the upstream device_puts each "
+                        "activation device-to-device, never via host")
     c.add_argument("--dag", action="store_true",
                    help="deploy the DAG planner's branch-parallel stage "
                         "GRAPH instead of a linear chain: parallel "

@@ -390,6 +390,51 @@ class Flatten(Op):
 
 
 @dataclasses.dataclass(frozen=True, repr=False)
+class Tile(Op):
+    """Repeat the per-sample input ``reps`` times along a new leading
+    axis — a cheap FAT-activation producer (output bytes = reps x input
+    bytes for one broadcast write).  Bench models for copy-bound
+    transport work (``scripts/ici_smoke.py``) use it to make a boundary
+    tensor fat without making the compute expensive."""
+
+    reps: int = 2
+
+    def apply(self, params, x):
+        del params
+        return jnp.broadcast_to(
+            x[:, None, ...], (x.shape[0], self.reps) + x.shape[1:])
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Cast(Op):
+    """Element dtype cast (e.g. to ``bfloat16`` — the TPU-native
+    activation regime, where a host round-trip pays a real
+    materialization the device-resident path skips)."""
+
+    dtype: str = "bfloat16"
+
+    def apply(self, params, x):
+        del params
+        return x.astype(self.dtype)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class ReduceMean(Op):
+    """Mean over one per-sample axis — the matching fat-activation
+    consumer (one read pass, thin output)."""
+
+    axis: int = 1
+
+    def apply(self, params, x):
+        del params
+        return jnp.mean(x, axis=self.axis)
+
+    def flops(self, in_specs, out_spec):
+        (spec,) = in_specs
+        return spec.size  # one add per reduced element
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
 class Embedding(Op):
     vocab: int
     features: int

@@ -327,6 +327,15 @@ class ClusterView:
                 "infer_ms": {k: round(float(
                     (lat.get("infer_s") or {}).get(k, 0.0)) * 1e3, 4)
                     for k in ("p50", "p95", "p99")},
+                # host-sync distribution (np.asarray materialization
+                # around the compute loop): an ici hop's row shows
+                # count == 0 — the observable proof the device-resident
+                # path skipped the host round-trip entirely
+                "host_sync_ms": {
+                    "p50": round(float((lat.get("host_sync_s") or {})
+                                       .get("p50", 0.0)) * 1e3, 4),
+                    "count": int((lat.get("host_sync_s") or {})
+                                 .get("count", 0))},
                 "service_ms": round(_service_ms(last), 4),
                 "rx_q": q.get("rx", 0), "tx_q": q.get("tx", 0),
                 "rx_hi": peak("rx_hi"), "tx_hi": peak("tx_hi"),

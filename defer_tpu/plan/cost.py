@@ -100,9 +100,24 @@ DEFAULT_CODECS: dict[str, CodecSpec] = {
 #:   read-out memcpy pair) — costlier than ``local``, decades cheaper
 #:   than any TCP hop, so the ladder's preference order (local over
 #:   shm over tcp) falls out of the model.
+#: * ``ici`` — same mesh, device-resident (``transport/ici.py``): the
+#:   activation never touches the host — zero encode/decode, zero
+#:   host-sync, wire term = the boundary bytes over the chip
+#:   interconnect (``hw.ici_bandwidth``, override with ``ici_bw_s=`` /
+#:   ``--ici-bw``).  At TPU ICI rates this sits between ``device``
+#:   (free) and ``local``.
 #: * ``device`` — the stages fuse into one jit program
 #:   (``partition.fuse_stages``): the hop does not exist; ~0 seconds.
+#:
+#: Every OTHER tier additionally pays the ``host_sync`` term (below):
+#: the per-hop D2H materialization + H2D re-upload the runtime's
+#: compute loops perform around any non-device-resident hop — the cost
+#: the ``local`` pseudo-codec used to omit silently, and the one the
+#: ici tier removes.  With it the model's preference order is
+#: principled: device <= ici <= local <= shm <= tcp.
 TIER_CODECS: dict[str, CodecSpec] = {
+    "ici": CodecSpec("ici", ratio=1.0, encode_bytes_per_s=0.0,
+                     decode_bytes_per_s=0.0),
     "local": CodecSpec("local", ratio=1.0, encode_bytes_per_s=0.0,
                        decode_bytes_per_s=0.0),
     "shm": CodecSpec("shm", ratio=1.0, encode_bytes_per_s=0.0,
@@ -116,6 +131,14 @@ TIER_CODECS: dict[str, CodecSpec] = {
 #: the planner needs relative weights, and ~10 GB/s keeps a colocated
 #: hop 2-3 decades under any TCP hop without rounding it to free).
 DEFAULT_LOCAL_BW_S = 1e10
+
+#: host-sync bandwidth: the D2H + H2D transfer pair every
+#: non-device-resident hop pays around its transport (the producing
+#: loop's ``np.asarray``, the consuming program's re-upload).  Same
+#: DRAM-class order of magnitude as :data:`DEFAULT_LOCAL_BW_S`;
+#: calibratable from the runtime's per-stage ``host_sync_s``
+#: histograms (docs/OBSERVABILITY.md).
+DEFAULT_HOST_SYNC_BW_S = 1e10
 
 
 def _check_hop_tiers(graph: LayerGraph,
@@ -229,7 +252,9 @@ class StageCostModel:
                  node_costs: dict[str, float] | None = None,
                  lossless_only: bool = False,
                  hop_tiers: dict[str, str] | None = None,
-                 local_bw_s: float | None = None):
+                 local_bw_s: float | None = None,
+                 ici_bw_s: float | None = None,
+                 host_sync_bw_s: float | None = None):
         self.graph = graph
         self.batch = max(int(batch), 1)
         if gen is None:
@@ -256,6 +281,18 @@ class StageCostModel:
         self.node_costs = dict(node_costs) if node_costs else None
         self.hop_tiers = _check_hop_tiers(graph, hop_tiers)
         self.local_bw_s = local_bw_s or DEFAULT_LOCAL_BW_S
+        #: device-to-device interconnect bandwidth for the ``ici``
+        #: pseudo-codec's wire term (defaults to the chip generation's
+        #: one-way ICI figure, like ``link_bw_s``; override for slower
+        #: meshes the same way ``--link-bw`` overrides the wire; 0 =
+        #: model the d2d wire as free, same convention as host_sync)
+        self.ici_bw_s = hw.ici_bandwidth(ref) if ici_bw_s is None \
+            else float(ici_bw_s)
+        #: D2H/H2D bandwidth for the per-hop host_sync term every
+        #: non-device-resident tier pays (0 = model the sync as free —
+        #: the same convention as a zero link bandwidth)
+        self.host_sync_bw_s = DEFAULT_HOST_SYNC_BW_S \
+            if host_sync_bw_s is None else float(host_sync_bw_s)
 
     @staticmethod
     def _detect_gen() -> str:
@@ -314,24 +351,46 @@ class StageCostModel:
                                            valid=valid_cuts)
         return other
 
+    def host_sync_seconds(self, cut: str) -> float:
+        """The per-hop host round-trip every non-device-resident
+        transport pays: the producing stage's D2H materialization
+        (``np.asarray`` in the compute loop) plus the consuming
+        program's H2D re-upload — two passes over the boundary bytes at
+        ``host_sync_bw_s``.  The ``ici`` tier keeps the activation
+        device-resident and the ``device`` tier has no hop at all, so
+        only tcp/local/shm hops carry this term; it is what makes the
+        tier ordering device <= ici <= local <= shm <= tcp principled
+        instead of accidental."""
+        return 2 * self.cut_bytes(cut) / self.host_sync_bw_s \
+            if self.host_sync_bw_s > 0 else 0.0
+
     def _tier_parts(self, cut: str, tier: str
                     ) -> tuple[float, float, float]:
-        """(encode, wire, decode) seconds of a colocated hop: zero codec
-        work on both sides; ``local`` pays one memory-bandwidth pass
-        over the boundary bytes, ``shm`` two (the ring's write-in +
-        read-out memcpy pair), ``device`` (a fused program) nothing."""
+        """(encode, wire, decode) seconds of a colocated hop: zero
+        codec work on both sides; ``ici`` pays one interconnect pass
+        (device-to-device, no host term), ``local`` one memory-
+        bandwidth pass over the boundary bytes plus the host_sync
+        round-trip, ``shm`` two passes (the ring's write-in + read-out
+        memcpy pair) plus host_sync, ``device`` (a fused program)
+        nothing."""
         if tier == "device":
             return 0.0, 0.0, 0.0
         n = self.cut_bytes(cut)
+        if tier == "ici":
+            wire = n / self.ici_bw_s if self.ici_bw_s > 0 else 0.0
+            return 0.0, wire, 0.0
         if tier == "shm":
             n *= 2
-        return TIER_CODECS["local"].comm_parts(n, self.local_bw_s)
+        enc, wire, dec = TIER_CODECS["local"].comm_parts(
+            n, self.local_bw_s)
+        return enc, wire + self.host_sync_seconds(cut), dec
 
     def comm_seconds(self, cut: str, codec: str) -> float:
         if codec in TIER_CODECS:
             return sum(self._tier_parts(cut, codec))
         return self.codecs[codec].comm_seconds(self.cut_bytes(cut),
-                                               self.link_bw_s)
+                                               self.link_bw_s) \
+            + self.host_sync_seconds(cut)
 
     def best_codec(self, cut: str) -> tuple[str, float]:
         """Cheapest (codec name, comm seconds) for the hop at ``cut``.
@@ -348,11 +407,17 @@ class StageCostModel:
 
     def comm_parts(self, cut: str, codec: str
                    ) -> tuple[float, float, float]:
-        """(encode, wire, decode) seconds for ``codec`` at ``cut``."""
+        """(encode, wire, decode) seconds for ``codec`` at ``cut``.
+        Wire codecs carry the host_sync round-trip split across the
+        encode (D2H materialization) and decode (H2D re-upload) sides —
+        each half parallelizes with its side's replicas, exactly like
+        the codec work it sits next to in the compute loops."""
         if codec in TIER_CODECS:
             return self._tier_parts(cut, codec)
-        return self.codecs[codec].comm_parts(self.cut_bytes(cut),
-                                             self.link_bw_s)
+        enc, wire, dec = self.codecs[codec].comm_parts(
+            self.cut_bytes(cut), self.link_bw_s)
+        h = self.host_sync_seconds(cut) / 2
+        return enc + h, wire, dec + h
 
     def best_codec_replicated(self, cut: str, r_up: int, r_down: int
                               ) -> tuple[str, float]:
@@ -373,8 +438,7 @@ class StageCostModel:
             return tier, sum(self._tier_parts(cut, tier))
         best_name, best = None, float("inf")
         for n in self.codecs:
-            enc, wire, dec = self.codecs[n].comm_parts(
-                self.cut_bytes(cut), self.link_bw_s)
+            enc, wire, dec = self.comm_parts(cut, n)
             s = enc / max(r_up, 1) + wire + dec / max(r_down, 1)
             if s < best:
                 best_name, best = n, s
@@ -403,6 +467,10 @@ class StageCostModel:
             "gen": self.gen, "batch": self.batch,
             "peak_flops_s": self.peak_flops_s, "hbm_bw_s": self.hbm_bw_s,
             "link_bw_s": self.link_bw_s,
+            # every non-device-resident hop pays the host round-trip,
+            # so its bandwidth travels with every plan (a replan seeded
+            # from plan JSON must keep scoring it)
+            "host_sync_bw_s": self.host_sync_bw_s,
             "node_costs": "measured" if self.node_costs else "roofline",
             "codecs": {n: dataclasses.asdict(c)
                        for n, c in self.codecs.items()},
@@ -410,6 +478,7 @@ class StageCostModel:
         if self.hop_tiers:
             d["hop_tiers"] = dict(sorted(self.hop_tiers.items()))
             d["local_bw_s"] = self.local_bw_s
+            d["ici_bw_s"] = self.ici_bw_s
         return d
 
 

@@ -155,8 +155,11 @@ def cost_model_from_plan(graph: LayerGraph, plan: Plan) -> StageCostModel:
         graph, node_costs=node_costs, hop_tiers=tiers or None,
         # the tier map's bandwidth half travels in the plan's cost_model
         # dict — without it a calibrated local_bw_s would silently reset
-        # to the default in replans seeded from plan JSON
-        local_bw_s=(plan.cost or {}).get("local_bw_s"))
+        # to the default in replans seeded from plan JSON (likewise the
+        # ici interconnect and host-sync bandwidths)
+        local_bw_s=(plan.cost or {}).get("local_bw_s"),
+        ici_bw_s=(plan.cost or {}).get("ici_bw_s"),
+        host_sync_bw_s=(plan.cost or {}).get("host_sync_bw_s"))
 
 
 def corrected_cost_model(graph: LayerGraph, plan: Plan,
@@ -186,7 +189,9 @@ def corrected_cost_model(graph: LayerGraph, plan: Plan,
         # tier-aware costs survive the correction: colocated hops stay
         # colocated in the re-solve
         hop_tiers=getattr(cost, "hop_tiers", None) or None,
-        local_bw_s=getattr(cost, "local_bw_s", None))
+        local_bw_s=getattr(cost, "local_bw_s", None),
+        ici_bw_s=getattr(cost, "ici_bw_s", None),
+        host_sync_bw_s=getattr(cost, "host_sync_bw_s", None))
 
 
 def replan(graph: LayerGraph, plan: Plan, source,

@@ -41,6 +41,7 @@ import numpy as np
 from ..obs import REGISTRY, LatencyHistogram, new_span_id, tracer
 from ..obs.report import ObsReporter, WatermarkSplit
 from ..transport.channel import AsyncReceiver, AsyncSender, _sampled
+from ..transport.ici import IciSender
 from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
                                 K_TENSOR_SEQ, configure_socket,
                                 connect_retry, recv_expect, recv_frame,
@@ -123,12 +124,18 @@ class StageNode:
     infer_delay_s: float = 0.0
     next_hops: list[tuple[str, int]] | None = None
     #: outbound transport-tier policy (docs/TRANSPORT.md): "auto" walks
-    #: the tier ladder on the downstream dial — local (same process)
-    #: over shm (same host, shared-memory ring) over tcp — via
-    #: tier_probe handshakes that silently degrade when a rung's proof
-    #: fails; "shm" offers only the shared-memory tier; "tcp" never
-    #: probes — the status-quo wire path
+    #: the tier ladder on the downstream dial — ici (same process +
+    #: same mesh, device-resident jax.Arrays) over local (same process,
+    #: host ndarray by reference) over shm (same host, shared-memory
+    #: ring) over tcp — via tier_probe handshakes that silently degrade
+    #: when a rung's proof fails; "ici"/"local"/"shm" pin that single
+    #: rung's offer; "tcp" never probes — the status-quo wire path
     tier: str = "tcp"
+    #: jax device index this node's stage program is pinned to (the
+    #: deployment half of the ici tier: upstream device_puts each
+    #: activation here, the program consumes it device-resident); None
+    #: = the backend default placement
+    device: int | None = None
     #: answer inbound tier probes (False = refuse every offer: the hop
     #: degrades to tcp with the sender's fallback counter bumped)
     tier_accept: bool = True
@@ -156,6 +163,13 @@ class StageNode:
     #: thread chains share across nodes — this instance copy keeps
     #: stats/obs_push attribution per node everywhere
     infer_hist: LatencyHistogram | None = None
+    #: per-NODE host-sync histogram: seconds spent materializing stage
+    #: outputs to host memory (``np.asarray`` — the D2H half of the
+    #: round-trip every non-ici hop pays; an ici hop records ZERO
+    #: samples here, which is the observable proof the round-trip is
+    #: gone).  Instance copy for the same attribution reason as
+    #: ``infer_hist``; ``node.host_sync_s`` is the registry twin.
+    host_sync_hist: LatencyHistogram | None = None
     #: per-subscriber watermark splitter (class default covers
     #: ``__new__``-built stubs; created lazily under ``_WM_LOCK``)
     _wm_split: WatermarkSplit | None = None
@@ -167,7 +181,8 @@ class StageNode:
                  fan_in: int = 1, replica: int | None = None,
                  fan_mode: str = "rr", branch: int | None = None,
                  join_in: int = 0, infer_delay_s: float = 0.0,
-                 tier: str = "tcp", tier_accept: bool = True):
+                 tier: str = "tcp", tier_accept: bool = True,
+                 device: int | None = None):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
         # process exists
@@ -200,13 +215,17 @@ class StageNode:
                              "replica fan-in (the two merges own "
                              "different sequence namespaces)")
         self.infer_delay_s = max(0.0, float(infer_delay_s))
-        if tier not in ("tcp", "auto", "shm"):
-            raise ValueError(f"tier must be tcp|auto|shm, got {tier!r}")
+        if tier not in ("tcp", "auto", "local", "shm", "ici"):
+            raise ValueError(f"tier must be tcp|auto|local|shm|ici, "
+                             f"got {tier!r}")
         self.tier = tier
         self.tier_accept = tier_accept
         self.tier_out = None
         self.tier_in = None
         self.tier_fallbacks = 0
+        self.device = None
+        if device is not None:
+            self.set_device(int(device))
         self._check_tier_pin()
         self.processed = 0    # tensors relayed, lifetime
         self.reweights = 0    # weights-only re-pushes accepted
@@ -225,6 +244,7 @@ class StageNode:
         self._live_rx = None
         self._live_tx = None
         self.infer_hist = LatencyHistogram()
+        self.host_sync_hist = LatencyHistogram()
         #: live obs_push reporter threads (one per subscription)
         self._reporters: list[ObsReporter] = []
 
@@ -257,15 +277,15 @@ class StageNode:
         return base
 
     def _check_tier_pin(self) -> None:
-        """Reject an explicit ``tier="shm"`` pin on a node whose hop
-        rides the ordered fan machinery (replica into a fan-in merge,
-        labeled branch into a join, fan-out next hops) — those paths
-        are wire-framed by design, so :meth:`_make_tx` would silently
-        skip the offer and run full codec + TCP under a tier claim
-        with ``tier_fallbacks`` still 0.  Mirrors the chain-level
-        ``hop_tiers`` adjacency guard; ``auto`` stays allowed (riding
-        tcp there is policy, not degradation)."""
-        if self.tier != "shm":
+        """Reject an explicit colocated-tier pin (``shm``/``ici``/
+        ``local``) on a node whose hop rides the ordered fan machinery
+        (replica into a fan-in merge, labeled branch into a join,
+        fan-out next hops) — those paths are wire-framed by design, so
+        :meth:`_make_tx` would silently skip the offer and run full
+        codec + TCP under a tier claim with ``tier_fallbacks`` still 0.
+        Mirrors the chain-level ``hop_tiers`` adjacency guard; ``auto``
+        stays allowed (riding tcp there is policy, not degradation)."""
+        if self.tier not in ("shm", "ici", "local"):
             return
         role = ("replica" if self.replica is not None
                 else "branch" if self.branch is not None
@@ -273,8 +293,58 @@ class StageNode:
                 and len(self.next_hops) > 1 else None)
         if role is not None:
             raise ValueError(
-                f"tier 'shm' pinned on a {role} node; fan paths ride "
-                f"tcp (drop the replicas/branching or the tier pin)")
+                f"tier {self.tier!r} pinned on a {role} node; fan paths "
+                f"ride tcp (drop the replicas/branching or the tier pin)")
+
+    def set_device(self, device: int) -> None:
+        """Pin this node's stage program to jax device index ``device``
+        (``jax.devices()[device]``): outputs stay resident there, and
+        an upstream ici hop device_puts each activation onto it before
+        the program runs.  Applied to an already-loaded program
+        immediately; an in-band deploy applies it at load."""
+        import jax
+        devs = jax.devices()
+        if not 0 <= device < len(devs):
+            raise ValueError(
+                f"device {device} out of range: this process has "
+                f"{len(devs)} jax device(s) (force a bigger host mesh "
+                f"with --xla_force_host_platform_device_count)")
+        self.device = device
+        if self.prog is not None:
+            self.prog.place(devs[device])
+
+    def _jax_device(self):
+        """The pinned jax device object, or None."""
+        if self.device is None:
+            return None
+        import jax
+        return jax.devices()[self.device]
+
+    def _host_sync(self, y, seq=None):
+        """Materialize one stage output to host memory (``np.asarray``
+        — the D2H sync every non-device-resident hop pays), timed into
+        the per-node ``host_sync_hist`` + the registry twin and
+        recorded as a ``stageK.host_sync`` span.  Device-resident (ici)
+        hops never call this, so their zero sample count is the
+        observable proof the host round-trip is gone."""
+        sync = getattr(y, "block_until_ready", None)
+        if sync is not None:
+            # finish the (async-dispatched) device compute FIRST: this
+            # histogram prices the host materialization the planner's
+            # host_sync term models — folding compute wait into it
+            # would mis-calibrate host_sync_bw_s by orders of magnitude
+            sync()
+        t0 = time.perf_counter()
+        out = np.asarray(y)
+        dt = time.perf_counter() - t0
+        REGISTRY.histogram("node.host_sync_s").record(dt)
+        if self.host_sync_hist is not None:
+            self.host_sync_hist.record(dt)
+        tr = tracer()
+        if tr.enabled and _sampled(self.trace_sample_every, seq):
+            tr.record(f"{self._span_label()}.host_sync", t0, dt,
+                      {} if seq is None else {"seq": seq})
+        return out
 
     def _make_tx(self, connect_timeout_s: float):
         """Open the downstream connection(s): one :class:`AsyncSender`,
@@ -307,7 +377,7 @@ class StageNode:
                 from ..transport.shm import offer_tier_ladder
                 self.tier_out, tx, fell_back = offer_tier_ladder(
                     socks[0], tier=self.tier, depth=self.tx_depth,
-                    hop=self._span_label())
+                    hop=self._span_label(), device=self._jax_device())
                 if fell_back:
                     self.tier_fallbacks += 1
             if tx is None:
@@ -435,12 +505,23 @@ class StageNode:
             if msg.get("tier"):
                 # outbound transport-tier policy rides the deploy
                 # handshake, like the hop codec
-                if msg["tier"] not in ("tcp", "auto", "shm"):
-                    raise ValueError(f"deploy: tier must be "
-                                     f"tcp|auto|shm, got {msg['tier']!r}")
+                if msg["tier"] not in ("tcp", "auto", "local", "shm",
+                                       "ici"):
+                    raise ValueError(
+                        f"deploy: tier must be tcp|auto|local|shm|ici, "
+                        f"got {msg['tier']!r}")
                 self.tier = msg["tier"]
             if msg.get("tier_accept") is not None:
                 self.tier_accept = bool(msg["tier_accept"])
+            # device residency rides the deploy handshake too: pin the
+            # freshly loaded program before any frame arrives — and a
+            # node booted with --device keeps its pin across an in-band
+            # deploy that doesn't mention one (the program object is
+            # new; the old placement must be re-applied to it)
+            dev = msg["device"] if msg.get("device") is not None \
+                else self.device
+            if dev is not None:
+                self.set_device(int(dev))
             self._check_tier_pin()
             send_ack(conn)
             return True
@@ -500,6 +581,7 @@ class StageNode:
             # per-node view the reference never had (SURVEY §5 metrics)
             m = self.manifest
             reg = REGISTRY
+            tx_live = self._live_tx
             send_ctrl(conn, {
                 "stage": None if m is None else m["index"],
                 "name": None if m is None else m["name"],
@@ -510,12 +592,23 @@ class StageNode:
                 "processed": self.processed,
                 "reweights": self.reweights,
                 "codec": self.codec,
-                # negotiated outbound transport tier ("local"/"shm"/
-                # "tcp"; the configured policy until a data path
+                # negotiated outbound transport tier ("ici"/"local"/
+                # "shm"/"tcp"; the configured policy until a data path
                 # negotiates) + this hop's degraded-offer count
                 "tier": self.tier_out or self.tier,
                 "tier_in": self.tier_in,
                 "tier_fallbacks": self.tier_fallbacks,
+                # device residency: this node's pinned jax device index
+                # and — on an ici outbound hop — the cross-device
+                # device_put count with the distinct (src, dst) device-
+                # id pairs, the stats-level proof a hop moved data
+                # between devices without touching the host
+                "device": self.device,
+                "ici_d2d": (tx_live.d2d
+                            if isinstance(tx_live, IciSender) else 0),
+                "ici_device_pairs": (sorted(
+                    [list(p) for p in tx_live.device_pairs])
+                    if isinstance(tx_live, IciSender) else []),
                 "next": None if not self.next_hops
                 else ",".join(f"{h}:{p}" for h, p in self.next_hops),
                 # wire telemetry: this node's process-local transport view
@@ -529,6 +622,14 @@ class StageNode:
                     (self.infer_hist.summary()
                      if self.infer_hist is not None
                      else reg.histogram("node.infer_s").summary()),
+                # host-sync distribution: np.asarray materialization
+                # seconds per frame — zero COUNT on ici hops (the
+                # device-resident proof), calibration input for the
+                # planner's host_sync term
+                "host_sync_s":
+                    (self.host_sync_hist.summary()
+                     if self.host_sync_hist is not None
+                     else reg.histogram("node.host_sync_s").summary()),
                 # phase timing: per-frame recv+decode / encode+send
                 # seconds of the data channels, plus the per-CHANNEL
                 # codec-only costs — the live bottleneck estimate's
@@ -615,7 +716,8 @@ class StageNode:
                      "port": self.address[1], "codec": self.codec,
                      "tier": self.tier_out or self.tier,
                      "tier_in": self.tier_in,
-                     "tier_fallbacks": self.tier_fallbacks},
+                     "tier_fallbacks": self.tier_fallbacks,
+                     "device": self.device},
             "processed": self.processed,
             "reweights": self.reweights,
             "counters": {
@@ -643,6 +745,10 @@ class StageNode:
                 "infer_s": (self.infer_hist.summary()
                             if self.infer_hist is not None
                             else reg.histogram("node.infer_s").summary()),
+                "host_sync_s": (self.host_sync_hist.summary()
+                                if self.host_sync_hist is not None
+                                else reg.histogram(
+                                    "node.host_sync_s").summary()),
                 "rx_s": reg.histogram("node.rx_s").summary(),
                 "tx_s": reg.histogram("node.tx_s").summary(),
                 "encode_s": (tx.enc.summary() if tx is not None
@@ -777,7 +883,16 @@ class StageNode:
             nonlocal n, streamed
             t0, s, y, relay_seq = pending.popleft()
             inflight_g.dec()
-            y = np.asarray(y)  # host sync of the OLDEST in-flight output
+            if isinstance(tx, IciSender):
+                # device-resident mode: the downstream hop accepts live
+                # jax.Arrays, so the output is NEVER materialized to
+                # host — only synced (bounding the dispatch window as
+                # before).  Zero host_sync samples on this node is the
+                # observable proof the round-trip is gone.
+                y.block_until_ready()
+            else:
+                # host sync of the OLDEST in-flight output
+                y = self._host_sync(y, seq=relay_seq)
             dt = time.perf_counter() - t0
             infer_hist.record(dt)
             if self.infer_hist is not None:
@@ -838,17 +953,20 @@ class StageNode:
                         continue
                     if isinstance(value, dict) \
                             and value.get("cmd") == "tier_probe":
-                        # colocated-tier handshake: a local grant SWAPS
-                        # the data path to the offered in-memory pipe;
-                        # a shm grant wraps this socket channel into a
-                        # ShmReceiver (descriptors keep riding the
-                        # socket as the doorbell, payloads come out of
-                        # the mapped ring); refused, the stream
-                        # continues on this socket
+                        # colocated-tier handshake: an ici/local grant
+                        # SWAPS the data path to the offered in-memory
+                        # pipe (ici frames stay live jax.Arrays,
+                        # device_put onto this node's pinned device by
+                        # the sender); a shm grant wraps this socket
+                        # channel into a ShmReceiver (descriptors keep
+                        # riding the socket as the doorbell, payloads
+                        # come out of the mapped ring); refused, the
+                        # stream continues on this socket
                         from ..transport.shm import answer_tier_probe
                         self.tier_in, chan = answer_tier_probe(
                             conn, value, accept=self.tier_accept,
-                            inner=rx, depth=self.rx_depth)
+                            inner=rx, depth=self.rx_depth,
+                            device=self._jax_device())
                         if chan is not None:
                             rx = chan
                             rx.sample_every = self.trace_sample_every
@@ -1038,7 +1156,7 @@ class StageNode:
                 if self.infer_delay_s:
                     time.sleep(self.infer_delay_s)  # bench-only device
                 t0 = time.perf_counter()
-                y = np.asarray(self.prog(value))
+                y = self._host_sync(self.prog(value), seq=relay_seq)
                 dt = time.perf_counter() - t0
                 infer_hist.record(dt)
                 if self.infer_hist is not None:
@@ -1185,7 +1303,13 @@ class StageNode:
             nonlocal n
             t0, s, y = pending.popleft()
             inflight_g.dec()
-            y = np.asarray(y)
+            if isinstance(tx, IciSender):
+                # the merge node's OUTBOUND hop can legitimately win
+                # ici (only its inbound fan is wire-framed): keep the
+                # output device-resident, zero host_sync samples
+                y.block_until_ready()
+            else:
+                y = self._host_sync(y)
             dt = time.perf_counter() - t0
             infer_hist.record(dt)
             if self.infer_hist is not None:
@@ -1383,7 +1507,12 @@ class StageNode:
             nonlocal n
             t0, s, y = pending.popleft()
             inflight_g.dec()
-            y = np.asarray(y)
+            if isinstance(tx, IciSender):
+                # a join node's outbound hop can win ici too — only
+                # the P inbound paths are wire-framed
+                y.block_until_ready()
+            else:
+                y = self._host_sync(y, seq=s)
             dt = time.perf_counter() - t0
             infer_hist.record(dt)
             if self.infer_hist is not None:
@@ -1509,8 +1638,9 @@ class ChainDispatcher:
                  tier: str = "tcp", tier_accept: bool | None = None):
         if timeout_s is not None:
             self.timeout_s = timeout_s
-        if tier not in ("tcp", "auto", "shm"):
-            raise ValueError(f"tier must be tcp|auto|shm, got {tier!r}")
+        if tier not in ("tcp", "auto", "local", "shm", "ici"):
+            raise ValueError(f"tier must be tcp|auto|local|shm|ici, "
+                             f"got {tier!r}")
         self.tier = tier
         #: default: grant result-hop offers exactly when this dispatcher
         #: itself plays the colocated game ("--tier tcp" forces a pure
@@ -1700,7 +1830,8 @@ class ChainDispatcher:
     def deploy(self, stages, params, node_addrs: Sequence, *,
                batch: int = 1, result_hop: str | None = None,
                codecs: Sequence[str] | None = None,
-               tiers: Sequence[str] | None = None):
+               tiers: Sequence[str] | None = None,
+               devices: Sequence[int | None] | None = None):
         """Ship each stage's artifact to its node(s) over the control
         channel.
 
@@ -1720,12 +1851,15 @@ class ChainDispatcher:
         Adjacent replicated stages are rejected — a replica cannot
         restore another fan-out's order.  ``codecs`` (per stage) sets
         each stage's OUTBOUND hop codec; default: this dispatcher's.
-        ``tiers`` (per stage, ``auto``/``shm``/``tcp``) sets each
-        stage's OUTBOUND transport-tier policy the same way — the
-        deploy-time half of the tier handshake (docs/TRANSPORT.md):
-        ``auto`` stages walk the local-over-shm-over-tcp ladder when
-        they open their downstream connection and silently degrade to
-        tcp when no rung's proof holds.
+        ``tiers`` (per stage, ``auto``/``ici``/``local``/``shm``/
+        ``tcp``) sets each stage's OUTBOUND transport-tier policy the
+        same way — the deploy-time half of the tier handshake
+        (docs/TRANSPORT.md): ``auto`` stages walk the
+        ici-over-local-over-shm-over-tcp ladder when they open their
+        downstream connection and silently degrade to tcp when no
+        rung's proof holds.  ``devices`` (per stage, jax device index
+        or None) pins each stage's program to a mesh device — the
+        deployment half of the device-resident ici tier.
 
         Deploying also sweeps ``/dev/shm`` for segments leaked by a
         previous chain whose processes were killed ungracefully
@@ -1754,6 +1888,10 @@ class ChainDispatcher:
                        "codec": codecs[i] if codecs else self.codec}
                 if tiers:
                     msg["tier"] = tiers[i]
+                if devices and devices[i] is not None:
+                    # pin stage i's program to a jax device (the
+                    # deployment half of the device-resident ici tier)
+                    msg["device"] = int(devices[i])
                 if i > 0 and len(groups[i - 1]) > 1:
                     msg["fan_in"] = len(groups[i - 1])
                 if len(addrs) > 1:
@@ -1887,16 +2025,17 @@ class ChainDispatcher:
                 cmd = y.get("cmd")
                 if cmd == "tier_probe":
                     # the last node offers its fast path on the result
-                    # dial-back: a local grant swaps results to the
-                    # in-memory pipe (the socket stays as lifetime
-                    # anchor), a shm grant wraps the socket channel
-                    # into a ShmReceiver (the socket becomes the
-                    # doorbell)
+                    # dial-back: an ici/local grant swaps results to
+                    # the in-memory pipe (the socket stays as lifetime
+                    # anchor; ici frames arrive as live jax.Arrays and
+                    # are host-synced HERE, exactly once per frame), a
+                    # shm grant wraps the socket channel into a
+                    # ShmReceiver (the socket becomes the doorbell)
                     from ..transport.shm import answer_tier_probe
                     self.tier_in, chan = answer_tier_probe(
                         self._res_conn, y, accept=self.tier_accept,
                         inner=self._rx_chan, depth=self.rx_depth)
-                    if self.tier_in == "local":
+                    if self.tier_in in ("local", "ici"):
                         old = self._rx_chan
                         self._rx_chan = chan
                         self._rx_chan.sample_every = \
@@ -1912,6 +2051,18 @@ class ChainDispatcher:
                     continue
                 if cmd in ("trace", "stream_begin"):
                     continue
+            if kind in (K_TENSOR, K_TENSOR_SEQ) \
+                    and self.tier_in == "ici":
+                # the chain's ONE host sync per frame: device-resident
+                # results materialize here, at the result edge — every
+                # upstream ici hop skipped its np.asarray entirely
+                t0 = time.perf_counter()
+                if kind == K_TENSOR_SEQ:
+                    y = (y[0], np.asarray(y[1]))
+                else:
+                    y = np.asarray(y)
+                REGISTRY.histogram("chain.host_sync_s").record(
+                    time.perf_counter() - t0)
             return kind, y
 
     # -- serve front door: request-scoped duplex stream --------------------
@@ -2271,10 +2422,10 @@ def _normalize_hop_tiers(hop_tiers, n: int, r_of: list[int],
         raise ValueError(f"hop_tiers must have one entry per inter-stage "
                          f"hop ({n - 1}), got {len(tiers)}")
     for k, t in enumerate(tiers):
-        if t not in ("tcp", "auto", "local", "shm", "device"):
+        if t not in ("tcp", "auto", "local", "shm", "ici", "device"):
             raise ValueError(f"hop_tiers[{k}] = {t!r}; "
-                             f"use tcp|auto|local|shm|device")
-        if t in ("local", "shm", "device") \
+                             f"use tcp|auto|local|shm|ici|device")
+        if t in ("local", "shm", "ici", "device") \
                 and (r_of[k] > 1 or r_of[k + 1] > 1):
             raise ValueError(
                 f"hop_tiers[{k}] = {t!r} but stage {k} or {k + 1} is "
@@ -2294,6 +2445,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               hop_codecs: Sequence[str] | None = None,
               hop_tiers: Sequence[str] | None = None,
               tier: str = "auto",
+              devices: int | None = None,
+              device_map: dict[int, int] | None = None,
               stage_delays: Sequence[float] | None = None,
               stats_out: list | None = None,
               spawn_retries: int = 3,
@@ -2331,6 +2484,12 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
       into a single jit-compiled stage program before spawn
       (``partition.fuse_stages``), so the hop — frame, queue, process —
       ceases to exist.
+    * ``"ici"`` — same process + same mesh: the two stages are
+      COLOCATED into one OS process and the hop negotiates the
+      DEVICE-RESIDENT channel — live ``jax.Array``s cross with no host
+      materialization at all (zero ``host_sync`` samples), and when
+      ``device_map`` pins the stages to distinct devices each frame
+      pays exactly one device-to-device ``jax.device_put``.
     * ``"local"`` — same process: the two stages are COLOCATED into one
       OS process (the downstream rides the upstream's process as a
       ``--co-stage`` serve thread) and the hop negotiates the
@@ -2343,17 +2502,21 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
       (``transport/shm.py``).  A failed handshake (cross-host peer,
       refusal) degrades to tcp the same way.
     * ``"auto"`` — separate processes; the hop walks the
-      local-over-shm-over-tcp ladder at connect time, so the standard
-      same-host multi-process chain negotiates shm everywhere without
-      being asked.
+      ici-over-local-over-shm-over-tcp ladder at connect time, so the
+      standard same-host multi-process chain negotiates shm everywhere
+      without being asked (and ici on any same-process hop).
     * ``"tcp"`` — the status-quo wire path, no probe.
 
-    Neither side of a ``device``/``local`` hop may be replicated (the
-    ordered fan machinery is wire-framed by design).  ``tier`` is the
-    policy for the dispatcher-edge hops (dispatcher -> stage 0, last
-    stage -> result server) and the default when ``hop_tiers`` is
-    omitted: ``"auto"`` (offers that degrade cleanly) or ``"tcp"`` (the
-    escape hatch — a pure wire chain end to end).
+    Neither side of a ``device``/``local``/``ici``/``shm`` hop may be
+    replicated (the ordered fan machinery is wire-framed by design).
+    ``tier`` is the policy for the dispatcher-edge hops (dispatcher ->
+    stage 0, last stage -> result server) and the default when
+    ``hop_tiers`` is omitted: ``"auto"`` (offers that degrade cleanly)
+    or ``"tcp"`` (the escape hatch — a pure wire chain end to end).
+    ``devices=N`` forces an N-device host mesh in every child
+    (``--xla_force_host_platform_device_count``); ``device_map``
+    ({stage: device index}) pins each stage's program — the deployment
+    half of the ici tier's cross-device transfers.
 
     Children that exit with an address-in-use bind failure (the
     ``_free_ports`` probe race) are detected and the whole spawn retries
@@ -2414,18 +2577,59 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
         delay_of = [float(d) for d in stage_delays] \
             if stage_delays is not None else [0.0] * n
         if tier not in ("tcp", "auto", "shm"):
+            # "ici"/"local" are structurally impossible as the CHAIN
+            # tier here: it also governs the dispatcher edges, and the
+            # dispatcher is always its own process in a spawned chain —
+            # the pin would silently run both edges over full codec +
+            # TCP under a tier claim (the exact failure mode the
+            # no-overlap and fan-role guards reject loudly)
+            if tier in ("ici", "local"):
+                raise ValueError(
+                    f"tier={tier!r} cannot hold on the dispatcher edges "
+                    f"of a spawned chain (the dispatcher is a separate "
+                    f"process); pin the stage hops with "
+                    f"hop_tiers=[{tier!r}, ...] and keep tier='auto'")
             raise ValueError(f"tier must be tcp|auto|shm, got {tier!r}")
         tiers = _normalize_hop_tiers(hop_tiers, n, r_of, tier)
-        claimed = [t for t in tiers if t in ("local", "shm")]
+        claimed = [t for t in tiers if t in ("local", "shm", "ici")]
         if not overlap and claimed:
             # the serial baseline loop is pure-wire by design and always
-            # refuses tier offers — an EXPLICIT local/shm claim would
-            # silently run full codec + TCP under a tier claim, so
-            # reject loudly (same rule as replicated colocated hops);
-            # "auto" offers still degrade cleanly under --no-overlap
+            # refuses tier offers — an EXPLICIT local/shm/ici claim
+            # would silently run full codec + TCP under a tier claim,
+            # so reject loudly (same rule as replicated colocated
+            # hops); "auto" offers still degrade cleanly under
+            # --no-overlap
             raise ValueError(
                 f"hop_tiers {claimed[0]!r} requires the overlapped node "
                 f"loop (drop overlap=False / --no-overlap)")
+        device_map = {int(k): int(v)
+                      for k, v in (device_map or {}).items()}
+        for k, v in device_map.items():
+            if not 0 <= k < n:
+                raise ValueError(
+                    f"device_map: stage {k} out of range 0..{n - 1}")
+            if v < 0:
+                raise ValueError(
+                    f"device_map: stage {k} device {v} must be >= 0")
+        if device_map and any(t == "device" for t in tiers):
+            # device-tier fusion rewrites stage indices before spawn, so
+            # a pre-fusion pin would land on the wrong stage (or vanish)
+            # silently — the same loud-miss policy as every other
+            # stage-indexed map
+            raise ValueError(
+                "device_map does not compose with device-tier fusion "
+                "(fusion renumbers the stages); fuse first and pin the "
+                "post-fusion chain, or drop the 'device' hops")
+        if device_map and devices is None:
+            # pinning stage programs needs the child host mesh to hold
+            # the named devices
+            devices = max(device_map.values()) + 1
+        if devices is not None:
+            bad = [v for v in device_map.values() if v >= devices]
+            if bad:
+                raise ValueError(
+                    f"device_map names device {bad[0]} but the forced "
+                    f"host mesh has only {devices} device(s)")
         if any(t == "device" for t in tiers):
             # fuse every device-tier hop: adjacent stages become ONE
             # jit-compiled stage program and the hop ceases to exist
@@ -2437,27 +2641,36 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
             tiers = [tiers[g[-1]] for g in groups[:-1]]
             n = len(stages)
         # colocation groups: maximal runs of stages joined by "local"
-        # hops share one OS process (co-stage serve threads)
+        # or "ici" hops share one OS process (co-stage serve threads —
+        # both tiers need one address space to hand a live object)
         coloc = [[0]]
         for k in range(n - 1):
-            if tiers[k] == "local":
+            if tiers[k] in ("local", "ici"):
                 coloc[-1].append(k + 1)
             else:
                 coloc.append([k + 1])
-        #: per-stage OUTBOUND tier policy argv ("local" claims ride the
-        #: same auto probe — colocation is what makes them succeed;
-        #: "shm" claims pin the shm-only offer: the stages stay in
-        #: separate OS processes and the payload crosses the shared-
-        #: memory ring)
-        tier_of = [("auto" if tiers[k] in ("auto", "local")
-                    else "shm" if tiers[k] == "shm" else "tcp")
+        #: per-stage OUTBOUND tier policy argv: explicit claims pin
+        #: that single rung's offer ("local" no longer rides the auto
+        #: ladder — auto's top rung is now ici, and a 'local' claim
+        #: must negotiate what it claimed); "shm" keeps the stages in
+        #: separate OS processes with the payload crossing the shared-
+        #: memory ring
+        tier_of = [(tiers[k] if tiers[k] in ("auto", "local", "shm",
+                                             "ici") else "tcp")
                    for k in range(n - 1)] + [tier]
 
         child_env = dict(os.environ)
         if env is None:
             env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
-                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count"
+                                "=1"}
         child_env.update(env)
+        if devices is not None:
+            # the forced mesh must hold under a CALLER-supplied env too
+            # (a device_map pin against a 1-device child dies at boot)
+            from ..utils.compat import host_device_count_flags
+            child_env["XLA_FLAGS"] = host_device_count_flags(
+                child_env.get("XLA_FLAGS"), devices)
 
         tuning = [] if overlap else ["--no-overlap"]
         for flag, v in (("--rx-depth", rx_depth), ("--tx-depth", tx_depth),
@@ -2483,7 +2696,7 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                     plan=plan, graph=graph,
                     report_interval_ms=report_interval_ms,
                     coloc=coloc, tier_of=tier_of, tier=tier,
-                    delay_of=delay_of)
+                    delay_of=delay_of, device_map=device_map)
             except _BindRace as e:
                 last_exc = e
                 print(f"run_chain: bind race on attempt {attempt + 1} "
@@ -2548,7 +2761,7 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                    rx_depth, tx_depth, stats_out, on_spawn,
                    trace_sample_every=0, plan=None, graph=None,
                    report_interval_ms=250.0, coloc=None, tier_of=None,
-                   tier="tcp", delay_of=None):
+                   tier="tcp", delay_of=None, device_map=None):
     """One spawn -> deploy -> stream -> teardown attempt (see
     ``run_chain``).  Raises :class:`_BindRace` when a child died with an
     address-in-use failure; any other failure surfaces the dead node's
@@ -2598,6 +2811,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             flags += ["--replica", str(j)]
         if delay_of and delay_of[k]:
             flags += ["--infer-delay-ms", str(delay_of[k] * 1e3)]
+        if device_map and device_map.get(k) is not None:
+            flags += ["--device", str(device_map[k])]
         return flags
 
     #: spawn units: one OS process each, hosting >= 1 (stage, replica)
@@ -2621,6 +2836,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             if not in_band:
                 spec += (f";artifact={paths[k]};next={next_of(k)}"
                          f";codec={codec_of[k]};tier={tier_of[k]}")
+            if device_map and device_map.get(k) is not None:
+                spec += f";device={device_map[k]}"
             argv += ["--co-stage", spec]
         return argv + tuning
 
@@ -2677,7 +2894,10 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
         try:
             if in_band:
                 disp.deploy(stages, params, addrs, batch=batch,
-                            codecs=codec_of, tiers=tier_of)
+                            codecs=codec_of, tiers=tier_of,
+                            devices=[device_map.get(k)
+                                     if device_map else None
+                                     for k in range(n)])
             if tracer().enabled:
                 # one coherent cross-process timeline: correct every
                 # node's wall anchor before any stream spans record

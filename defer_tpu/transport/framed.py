@@ -220,6 +220,28 @@ _HDR = struct.Struct(">BBBBQ")
 MAX_FRAME = 1 << 34  # 16 GiB sanity bound
 
 
+def wire_dtype(dtype) -> str:
+    """The dtype string a frame (or shm doorbell descriptor) ships:
+    numpy's ``.str`` for builtin dtypes, the registered NAME (e.g.
+    ``bfloat16``) for extension dtypes whose ``.str`` is an opaque void
+    alias (``<V2``) that would decode as raw bytes on the far end."""
+    s = dtype.str
+    if np.dtype(s) != dtype:
+        return dtype.name
+    return s
+
+
+def dtype_from_wire(s: str) -> np.dtype:
+    """Inverse of :func:`wire_dtype`.  Extension-dtype NAMES only
+    resolve once ml_dtypes has registered them — import it on demand
+    so a consumer that never imported jax still decodes bf16."""
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 — import registers the dtypes
+        return np.dtype(s)
+
+
 def _sendv(sock: socket.socket, *parts) -> None:
     """Scatter-gather sendall (``sendmsg``/writev): the frame goes out as
     one syscall per kernel-buffer fill with NO concatenation copy of the
@@ -272,7 +294,7 @@ def send_frame(sock: socket.socket, arr_or_bytes, *, codec: str = "raw",
         if on_encode is not None:
             on_encode(dt)
         cname = codec.encode()
-        dt = arr.dtype.str.encode()
+        dt = wire_dtype(arr.dtype).encode()
         meta = dt + b"".join(struct.pack(">Q", s) for s in arr.shape)
         ndim = arr.ndim
     dt_len = len(meta) - 8 * ndim if kind != K_BYTES else 0
@@ -356,7 +378,7 @@ def recv_frame(sock: socket.socket, *, on_decode=None) -> tuple[int, Any]:
     cname = _recv_into(sock, clen).decode()
     if kind == K_BYTES:
         return K_BYTES, _recv_exact(sock, plen)
-    dt = np.dtype(_recv_into(sock, dlen).decode())
+    dt = dtype_from_wire(_recv_into(sock, dlen).decode())
     shape = tuple(struct.unpack(">Q", _recv_into(sock, 8))[0]
                   for _ in range(ndim))
     buf = _recv_into(sock, plen)

@@ -1,0 +1,263 @@
+"""Device-resident transport tier: live ``jax.Array`` handoff across stages.
+
+Every colocated tier so far still ROUND-TRIPS the host per hop: the
+producing stage materializes its output with ``np.asarray`` (a D2H
+sync), hands host bytes to the channel (``local`` by reference, ``shm``
+through a ring), and the consuming stage's program re-uploads them with
+a fresh device transfer.  On a real TPU mesh that is a D2H + H2D pair
+per activation per hop — the exact cost GSPMD's co-scheduled programs
+and MPK's mega-kernels exist to avoid (PAPERS.md).  This module is the
+missing top rung of the tier ladder:
+
+* :class:`IciPipe` — a bounded in-process frame stream (the
+  :class:`~defer_tpu.transport.local.LocalPipe` machinery verbatim:
+  seq stamping, in-order K_CTRL, cascading K_END, bounded backpressure,
+  peer death poisoning both ends) whose tensor frames carry **live
+  ``jax.Array``s**.  No ``np.asarray``, no codec, no socket payload:
+  the consuming stage program ingests the device buffer directly, and
+  the only host sync left in the whole chain is the dispatcher's result
+  edge — exactly once per frame.
+* **Cross-device hops** — when the two stages are pinned to *distinct*
+  jax devices, :meth:`IciSender.send` performs one
+  ``jax.device_put(x, device)`` (a device-to-device transfer, never via
+  host) so the receiver's pinned program consumes the array without a
+  placement conflict.  Same-device (or unpinned) hops pass the array by
+  reference — zero copies.  The sender counts its cross-device puts and
+  the (src, dst) device-id pairs, so stats can PROVE a hop moved data
+  between distinct devices.
+* **Negotiation** — the probe carries ``{"cmd": "tier_probe", "want":
+  "ici", backend, platform, device_ids, pid, proto, token}``.  The
+  grantor accepts only when the protocol version and pid match, the
+  token resolves in this process's offer registry (live object handoff
+  needs one address space — the same structural proof the ``local``
+  tier uses), AND it can resolve every offered device id on its own
+  backend: the resolve IS the same-mesh proof, the same
+  proof-by-capability shape as the shm grant's segment open (a peer on
+  another mesh/backend can name devices this process cannot resolve).
+  The ``tier_reply`` carries the receiver's pinned device id (or None)
+  so the sender knows where to ``device_put``.  Any failed check
+  silently degrades the hop down the ladder
+  (``transport.shm.offer_tier_ladder``) with one labeled
+  ``transport.tier_fallback.<hop>`` count for the whole ladder.
+
+The multi-device CPU host (``XLA_FLAGS
+--xla_force_host_platform_device_count=N``, see
+``utils.compat.force_host_device_count``) is the test vehicle: it gives
+a real N-device mesh in one process, so grant validation, cross-device
+``device_put``, and byte identity are exercised for real without a TPU.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+
+from ..obs import REGISTRY
+from .framed import (K_CTRL, K_TENSOR, K_TENSOR_SEQ, PROTOCOL_VERSION,
+                     recv_expect, send_ctrl)
+from .local import LocalPipe, LocalReceiver, LocalSender, record_fallback
+
+__all__ = ["IciPipe", "IciReceiver", "IciSender", "grant_ici",
+           "offer_ici"]
+
+#: tensor frames handed device-resident through ici pipes (the
+#: device-resident analogue of ``transport.local_frames`` — ici hops
+#: bump neither the wire counters nor the local/shm ones, so each
+#: counter keeps meaning exactly one transport)
+_ICI_FRAMES = REGISTRY.counter("transport.ici_frames")
+
+#: cross-device ``device_put`` transfers performed by ici senders
+_ICI_D2D = REGISTRY.counter("transport.ici_d2d")
+
+#: token -> IciPipe: offers awaiting a grant.  Process-local on purpose
+#: — a live jax.Array can only be handed within one address space, so
+#: an unresolvable token refuses the offer structurally (same shape as
+#: the local tier's registry proof).
+_OFFERS: dict[str, "IciPipe"] = {}
+_OFFERS_LOCK = threading.Lock()
+
+
+def _device_of(arr):
+    """The single jax device holding ``arr``, or None for host arrays
+    (numpy inputs at the dispatcher's feed edge) and sharded arrays."""
+    devices = getattr(arr, "devices", None)
+    if devices is None:
+        return None
+    try:
+        ds = devices()
+        if len(ds) == 1:
+            return next(iter(ds))
+    except Exception:  # noqa: BLE001 — deleted/donated arrays
+        return None
+    return None
+
+
+class IciSender(LocalSender):
+    """Producer end of an ici hop (AsyncSender surface).
+
+    ``send`` keeps the array device-resident: same-device (or unpinned)
+    hops hand the live ``jax.Array`` by reference; a hop whose receiver
+    is pinned to a *different* device pays exactly one
+    ``jax.device_put`` — the device-to-device DMA the tier exists to
+    expose — recorded in ``d2d``/``device_pairs`` so stats can assert a
+    real cross-device transfer happened.  Everything else (bounded
+    backpressure, ordered ctrl, cascading END, peer-death poisoning) is
+    the LocalSender contract verbatim.
+    """
+
+    codec = "ici"   #: nominal; no codec (or host byte) ever touches ici
+
+    def __init__(self, pipe: "IciPipe"):
+        super().__init__(pipe)
+        #: receiver's pinned jax device (from the tier_reply), or None
+        self.dest_device = None
+        #: cross-device device_put transfers this sender performed
+        self.d2d = 0
+        #: distinct (src_id, dst_id) pairs of those transfers
+        self.device_pairs: set[tuple[int, int]] = set()
+
+    def send(self, arr, *, seq: int | None = None) -> None:
+        dest = self.dest_device
+        if dest is not None:
+            src = _device_of(arr)
+            if src is None or src.id != dest.id:
+                import jax
+                arr = jax.device_put(arr, dest)
+                if src is not None and src.id != dest.id:
+                    # a real device-to-device transfer (never via host)
+                    self.d2d += 1
+                    _ICI_D2D.n += 1
+                    self.device_pairs.add((src.id, dest.id))
+        if seq is None:
+            self._put((K_TENSOR, arr))
+        else:
+            self._put((K_TENSOR_SEQ, (seq, arr)))
+        _ICI_FRAMES.n += 1
+
+
+class IciReceiver(LocalReceiver):
+    """Consumer end of an ici hop (AsyncReceiver surface): the
+    LocalReceiver contract verbatim — tensor frames are live
+    ``jax.Array``s the consuming stage program ingests directly."""
+
+
+class IciPipe(LocalPipe):
+    """One bounded in-process stream of device-resident frames."""
+
+    sender_cls = IciSender
+    receiver_cls = IciReceiver
+
+
+# ---------------------------------------------------------------------------
+# negotiation
+# ---------------------------------------------------------------------------
+
+def _register(pipe: IciPipe) -> str:
+    token = uuid.uuid4().hex
+    with _OFFERS_LOCK:
+        _OFFERS[token] = pipe
+    return token
+
+
+def _claim(token) -> IciPipe | None:
+    with _OFFERS_LOCK:
+        return _OFFERS.pop(token, None)
+
+
+def _mesh_ident(device=None) -> dict:
+    """This process's half of the same-mesh proof: backend, platform,
+    and the device ids the sender's outputs will live on (its pinned
+    device, else the backend's default device)."""
+    import jax
+    devs = [device] if device is not None else [jax.devices()[0]]
+    return {"backend": jax.default_backend(),
+            "platform": devs[0].platform,
+            "device_ids": [d.id for d in devs]}
+
+
+def offer_ici(sock, *, depth: int = 8, hop: str | None = None,
+              device=None, fallback: bool = True
+              ) -> tuple[str, IciSender | None]:
+    """Offer the device-resident tier on a freshly dialed data socket.
+
+    Sends the ``tier_probe`` (first frame on the connection, so the
+    reply cannot interleave with data) carrying this side's mesh
+    identity — ``device`` is the jax device the sender's outputs are
+    pinned to (None = backend default) — and awaits the ``tier_reply``.
+    Granted: returns ``("ici", sender)`` with the sender's
+    ``dest_device`` resolved from the reply's receiver device id, and
+    the socket stays open as the hop's lifetime anchor.  Refused
+    (cross-process peer, foreign mesh, version mismatch, tcp-pinned
+    peer): ``("tcp", None)``, bumping ``transport.tier_fallback`` (per
+    ``hop``) when ``fallback`` — ``fallback=False`` for ladder callers
+    that will offer the next rung on the same socket, so one degraded
+    hop never counts twice.  A host without a usable jax backend
+    refuses locally (no probe) and returns ``("tcp", None)``.
+    """
+    try:
+        ident = _mesh_ident(device)
+    except Exception:  # noqa: BLE001 — no backend: the rung cannot hold
+        if fallback:
+            record_fallback(hop)
+        return "tcp", None
+    pipe = IciPipe(depth=depth)
+    token = _register(pipe)
+    try:
+        send_ctrl(sock, {"cmd": "tier_probe", "want": "ici",
+                         "pid": os.getpid(), "proto": PROTOCOL_VERSION,
+                         "token": token, **ident})
+        reply = recv_expect(sock, K_CTRL)
+    finally:
+        _claim(token)  # granted probes were already claimed by the peer
+    if isinstance(reply, dict) and reply.get("cmd") == "tier_reply" \
+            and reply.get("tier") == "ici":
+        sender: IciSender = pipe.sender
+        dev_id = reply.get("device")
+        if dev_id is not None:
+            import jax
+            by_id = {d.id: d for d in jax.devices()}
+            sender.dest_device = by_id.get(int(dev_id))
+        return "ici", sender
+    if fallback:
+        record_fallback(hop)
+    return "tcp", None
+
+
+def grant_ici(msg) -> IciPipe | None:
+    """Validate one ici ``tier_probe``; return the offered pipe when
+    the same-process AND same-mesh claims both hold, else None (caller
+    replies ``tier_reply: tcp``/the next rung and the hop degrades).
+
+    Checks, in order: the probe wants ``ici``; the wire protocol
+    version matches; the peer's pid is THIS process's (a live
+    ``jax.Array`` can only be handed within one address space); the
+    offered backend/platform match this process's jax backend; every
+    offered device id RESOLVES on it — the resolve is the same-mesh
+    proof (a peer on another mesh names devices this backend cannot
+    resolve, so a forged pid alone is never enough); and the token
+    resolves in this process's offer registry."""
+    if not isinstance(msg, dict) or msg.get("want") != "ici":
+        return None
+    try:
+        if int(msg.get("proto", -1)) != PROTOCOL_VERSION:
+            return None
+        if int(msg.get("pid", -1)) != os.getpid():
+            return None
+    except (TypeError, ValueError):
+        return None
+    try:
+        import jax
+        if msg.get("backend") != jax.default_backend():
+            return None
+        devs = {d.id: d for d in jax.devices()}
+    except Exception:  # noqa: BLE001 — no backend here: cannot grant
+        return None
+    ids = msg.get("device_ids")
+    if not isinstance(ids, (list, tuple)) or not ids:
+        return None
+    for i in ids:
+        d = devs.get(i if isinstance(i, int) else None)
+        if d is None or d.platform != msg.get("platform"):
+            return None
+    return _claim(msg.get("token"))

@@ -97,6 +97,12 @@ class LocalPipe:
     frame came off a socket or a pipe.
     """
 
+    #: end-class hooks so subclasses (the device-resident ici tier)
+    #: inherit the pipe machinery — bounded backpressure, ordered ctrl,
+    #: cascading END, both-direction peer-death poisoning — verbatim
+    sender_cls: type["LocalSender"]
+    receiver_cls: type["LocalReceiver"]
+
     def __init__(self, depth: int = 8):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
@@ -119,8 +125,8 @@ class LocalPipe:
         self._glock = threading.Lock()
         self._enq = 0
         self._deq = 0
-        self.sender = LocalSender(self)
-        self.receiver = LocalReceiver(self)
+        self.sender = self.sender_cls(self)
+        self.receiver = self.receiver_cls(self)
 
 
 class LocalSender:
@@ -294,6 +300,11 @@ class LocalReceiver:
 
     def qsize(self) -> int:
         return self._q.qsize()
+
+
+#: bound after the classes exist (LocalPipe is defined first)
+LocalPipe.sender_cls = LocalSender
+LocalPipe.receiver_cls = LocalReceiver
 
 
 # ---------------------------------------------------------------------------

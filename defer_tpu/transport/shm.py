@@ -67,7 +67,8 @@ import numpy as np
 from ..obs import REGISTRY, LatencyHistogram
 from .channel import ChannelError
 from .framed import (K_CTRL, K_TENSOR, K_TENSOR_SEQ, PROTOCOL_VERSION,
-                     recv_expect, send_ctrl, send_end)
+                     dtype_from_wire, recv_expect, send_ctrl, send_end,
+                     wire_dtype)
 from .local import record_fallback
 
 __all__ = ["ShmReceiver", "ShmRing", "ShmSender", "answer_tier_probe",
@@ -299,9 +300,13 @@ class ShmSender:
         if arr.nbytes > self._ring.slot_bytes:
             self._grow(arr.nbytes)
         slot = self._claim_slot()
-        self._ring.write(slot, memoryview(arr).cast("B"))
+        # byte-reinterpret BEFORE taking the memoryview: extension
+        # dtypes (bfloat16's buffer format 'E') reject a plain
+        # .cast("B"), while a uint8 view of the same contiguous data
+        # is always castable
+        self._ring.write(slot, memoryview(arr.view(np.uint8)).cast("B"))
         msg = {"cmd": "shm_frame", "slot": slot, "nbytes": arr.nbytes,
-               "dtype": arr.dtype.str, "shape": list(arr.shape)}
+               "dtype": wire_dtype(arr.dtype), "shape": list(arr.shape)}
         if seq is not None:
             msg["seq"] = int(seq)
         with self._ilock:
@@ -445,7 +450,7 @@ class ShmReceiver:
         cmd = value.get("cmd")
         if cmd == "shm_frame":
             arr = np.frombuffer(
-                self._seg.buf, dtype=np.dtype(value["dtype"]),
+                self._seg.buf, dtype=dtype_from_wire(value["dtype"]),
                 count=int(np.prod(value["shape"], dtype=np.int64))
                 if value["shape"] else 1,
                 offset=int(value["slot"]) * self.slot_bytes,
@@ -555,26 +560,39 @@ def offer_shm(sock, *, depth: int = 8,
 
 
 def offer_tier_ladder(sock, *, tier: str, depth: int = 8,
-                      hop: str | None = None):
+                      hop: str | None = None, device=None):
     """Walk the sender-side tier ladder on a freshly dialed data
-    socket: local (same process) over shm (same host) over tcp, one
-    probe per rung on the SAME socket.  ``tier="auto"`` offers every
-    rung; ``tier="shm"`` pins the shm-only offer.  Returns
+    socket: ici (same process + same mesh, device-resident) over local
+    (same process, host ndarray by reference) over shm (same host,
+    shared-memory ring) over tcp, one probe per rung on the SAME
+    socket.  ``tier="auto"`` offers every rung; ``tier="ici"`` /
+    ``"local"`` / ``"shm"`` pin that single rung's offer.  ``device``
+    is the jax device the offering side's outputs are pinned to (the
+    ici probe's mesh identity; None = backend default).  Returns
     ``(tier_out, tx_or_None, fell_back)`` — a granted rung's sender
     (the socket stays open as the hop's lifetime anchor / doorbell), or
     ``("tcp", None, True)`` when every offer was refused, with ONE
-    fallback recorded for the whole ladder (the local rung's refusal is
-    not yet a fallback while shm is still to be tried).  The single
-    place the ladder's rung order and fallback accounting live, shared
-    by stage hops and the dispatcher's first/result edges."""
+    fallback recorded for the whole ladder (an upper rung's refusal is
+    not yet a fallback while a lower rung is still to be tried).  The
+    single place the ladder's rung order and fallback accounting live,
+    shared by stage hops and the dispatcher's first/result edges."""
+    from .ici import offer_ici
     from .local import offer_local
     tx = None
     tier_out = "tcp"
-    if tier == "auto":
+    if tier in ("auto", "ici"):
+        tier_out, tx = offer_ici(sock, depth=depth, hop=hop,
+                                 device=device,
+                                 fallback=(tier == "ici"))
+        if tx is not None or tier == "ici":
+            return tier_out, tx, tx is None
+    if tier in ("auto", "local"):
         tier_out, pipe = offer_local(sock, depth=depth, hop=hop,
-                                     fallback=False)
+                                     fallback=(tier == "local"))
         if pipe is not None:
             tx = pipe.sender
+        if tx is not None or tier == "local":
+            return tier_out, tx, tx is None
     if tx is None:
         tier_out, tx = offer_shm(sock, depth=depth, hop=hop)
     return tier_out, tx, tx is None
@@ -606,19 +624,30 @@ def grant_shm(msg) -> shared_memory.SharedMemory | None:
 
 
 def answer_tier_probe(conn, msg, *, accept: bool = True, inner=None,
-                      depth: int = 8):
+                      depth: int = 8, device=None):
     """Receiver-side handshake for EVERY colocated tier: validate
     ``msg`` (when ``accept``), send the ``tier_reply`` on ``conn``, and
-    return ``(tier, receiver_or_None)`` — ``("local", LocalReceiver)``,
-    ``("shm", ShmReceiver)``, or ``("tcp", None)``.  ``inner`` is the
-    hop's live socket frame source (required to grant shm — the
-    doorbell rides it).  The one helper every granting serve loop uses
-    so a probe is ALWAYS answered; refusal-only loops keep
+    return ``(tier, receiver_or_None)`` — ``("ici", IciReceiver)``,
+    ``("local", LocalReceiver)``, ``("shm", ShmReceiver)``, or
+    ``("tcp", None)``.  ``inner`` is the hop's live socket frame source
+    (required to grant shm — the doorbell rides it); ``device`` is the
+    granting side's pinned jax device, echoed in the ici ``tier_reply``
+    so the sender knows where to ``device_put`` cross-device frames.
+    The one helper every granting serve loop uses so a probe is ALWAYS
+    answered; refusal-only loops keep
     ``transport.local.answer_probe(..., accept=False)``, which refuses
     any want."""
     from .local import grant_local
     want = msg.get("want") if isinstance(msg, dict) else None
-    if accept and want == "local":
+    if accept and want == "ici":
+        from .ici import grant_ici
+        pipe = grant_ici(msg)
+        if pipe is not None:
+            send_ctrl(conn, {"cmd": "tier_reply", "tier": "ici",
+                             "device": None if device is None
+                             else device.id})
+            return "ici", pipe.receiver
+    elif accept and want == "local":
         pipe = grant_local(msg)
         if pipe is not None:
             send_ctrl(conn, {"cmd": "tier_reply", "tier": "local"})

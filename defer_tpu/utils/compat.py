@@ -41,6 +41,49 @@ def pcast_varying(x, axes):
     return pc(x, axes, to="varying")
 
 
+def host_device_count_flags(flags: str | None, n: int) -> str:
+    """``XLA_FLAGS`` string with the host-platform device-count flag
+    forced to ``n`` (any existing count flag replaced) — shared by
+    :func:`force_host_device_count` and ``run_chain``'s child-env
+    rewrite so the flag format lives in one place."""
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags or "").strip()
+    return f"{flags} --xla_force_host_platform_device_count={n}".strip()
+
+
+def force_host_device_count(n: int) -> tuple[bool, str]:
+    """Arrange for the host platform to expose ``n`` XLA devices
+    (``--xla_force_host_platform_device_count``) — the test vehicle for
+    same-mesh multi-device work (the ici transport tier, sharding
+    tests) on hosts without a real accelerator mesh.
+
+    Must run BEFORE jax initializes its backends: the flag is read once
+    at backend construction.  Returns ``(ok, reason)`` — ``ok`` is True
+    when the flag took (or the backend already exposes >= n devices),
+    False with a skip-worthy ``reason`` when jax already initialized
+    with fewer devices (callers like the conftest fixture turn that
+    into a skip instead of a wrong-mesh test run).
+    """
+    import os
+
+    n = int(n)
+    backends = getattr(getattr(jax._src, "xla_bridge", None),
+                       "_backends", None)
+    if backends:
+        have = len(jax.devices())
+        if have >= n:
+            return True, f"backend already initialized with {have} devices"
+        return False, (f"jax already initialized with {have} host "
+                       f"device(s) < {n}; set XLA_FLAGS="
+                       f"--xla_force_host_platform_device_count={n} "
+                       f"before the first jax call")
+    os.environ["XLA_FLAGS"] = host_device_count_flags(
+        os.environ.get("XLA_FLAGS"), n)
+    return True, f"XLA_FLAGS set for {n} host devices"
+
+
 def axis_size(axis_name) -> int:
     """Static size of a mapped mesh axis (``lax.axis_size`` on current
     jax; the ``core.axis_frame`` lookup on legacy versions, where the

@@ -130,6 +130,7 @@ class StageProgram:
     def __init__(self, exported, leaves: list, manifest: dict):
         self._exported = exported
         self.manifest = manifest
+        self.device = None
         self._install(leaves)
 
     def _install(self, leaves: list):
@@ -141,7 +142,26 @@ class StageProgram:
         self._leaves = leaves
         # *xs: a join-stage artifact (manifest["num_inputs"] > 1) takes
         # one array per merged branch path, single-input stages just one
-        self.fn = jax.jit(lambda *xs: call(leaves, *xs))
+        base = jax.jit(lambda *xs: call(leaves, *xs))
+        if self.device is None:
+            self.fn = base
+        else:
+            # committing the inputs pins the computation: jit places the
+            # executable on its committed arguments' device.  device_put
+            # of an array already resident there is a no-op, so the
+            # device-resident (ici) hand-off path pays nothing here.
+            dev = self.device
+            self.fn = lambda *xs: base(
+                *(jax.device_put(x, dev) for x in xs))
+
+    def place(self, device) -> None:
+        """Pin the program to one jax device: every call runs (and its
+        output lives) there — the deployment half of the device-resident
+        ``ici`` transport tier, where the UPSTREAM hop device_puts each
+        activation onto this device and the program consumes it without
+        any host round-trip."""
+        self.device = device
+        self._install(self._leaves)
 
     def reweight(self, blob: bytes):
         """Install a weights npz blob (shapes must match the artifact's)."""

@@ -121,7 +121,7 @@ def quick_check(stages, params, xs, *, delay_ms: float,
     base, base_s, base_st = run_inproc(stages, params, xs, tier="tcp",
                                        codecs=codecs)
     enc0 = REGISTRY.histogram("codec.encode_s").summary().get("count", 0)
-    loc, loc_s, loc_st = run_inproc(stages, params, xs, tier="auto",
+    loc, loc_s, loc_st = run_inproc(stages, params, xs, tier="local",
                                     codecs=codecs)
     enc1 = REGISTRY.histogram("codec.encode_s").summary().get("count", 0)
 
@@ -157,7 +157,7 @@ def fused_check(stages, params, xs, *, delay_ms: float, base) -> dict:
     tr.start_trace()
     tx0 = REGISTRY.counter("transport.tx_frames").value
     lf0 = REGISTRY.counter("transport.local_frames").value
-    outs, wall, stats = run_inproc(fused, params, xs, tier="auto",
+    outs, wall, stats = run_inproc(fused, params, xs, tier="local",
                                    codecs=["raw"], streams=1)
     tx_frames = REGISTRY.counter("transport.tx_frames").value - tx0
     local_frames = REGISTRY.counter("transport.local_frames").value - lf0
@@ -244,15 +244,22 @@ def timed_chain(paths, xs_warm, xs, *, colocate: bool, delay_ms: float,
     addrs = [f"127.0.0.1:{p}" for p in ports[:3]]
     result = f"127.0.0.1:{ports[3]}"
     nxt = addrs[1:] + [result]
+    # dispatcher edges are always cross-process: keep them on "auto"
+    # (they negotiate shm as before) — only the IN-process co-stage
+    # hops pin "local", since auto's top rung is now the ici tier
     tier = "auto" if colocate else "tcp"
     if colocate:
         argv = [sys.executable, "-m", "defer_tpu", "node",
                 "--artifact", paths[0], "--listen", addrs[0],
-                "--next", nxt[0], "--codec", codecs[0], "--tier", "auto"]
+                "--next", nxt[0], "--codec", codecs[0], "--tier", "local"]
         for k in (1, 2):
+            # the LAST housemate's outbound is the result edge (cross-
+            # process): a "local" pin there could only degrade to tcp
+            co_tier = "local" if k < 2 else "auto"
             argv += ["--co-stage",
                      f"listen={addrs[k]};artifact={paths[k]}"
-                     f";next={nxt[k]};codec={codecs[k]};tier=auto"]
+                     f";next={nxt[k]};codec={codecs[k]};tier={co_tier}"
+                     f";accept=1"]
         argvs = [argv]
         proc_of = [0, 0, 0]
     else:

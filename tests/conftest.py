@@ -9,12 +9,16 @@ selection through jax.config.
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the one place the multi-device host mesh is forced (the flag must land
+# before jax initializes its backends; the helper refuses with a reason
+# when that window has closed)
+from defer_tpu.utils.compat import force_host_device_count  # noqa: E402
+
+_DEVICES_OK, _DEVICES_WHY = force_host_device_count(8)
 
 import jax  # noqa: E402
 
@@ -32,8 +36,21 @@ def pytest_configure(config):
 
 @pytest.fixture(scope="session", autouse=True)
 def _devices():
-    assert len(jax.devices()) == 8, jax.devices()
+    assert len(jax.devices()) == 8, (jax.devices(), _DEVICES_WHY)
     yield
+
+
+@pytest.fixture
+def host_devices():
+    """The forced multi-device host mesh, or a skip-with-reason when
+    this process's jax initialized before the flag could land — the
+    test vehicle for device-resident (ici) and sharding tests."""
+    if not _DEVICES_OK:
+        pytest.skip(_DEVICES_WHY)
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip(f"needs a multi-device host mesh, have {len(devs)}")
+    return devs
 
 
 #: per-test watchdog so one hung multi-process/socket test cannot eat the

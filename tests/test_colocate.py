@@ -275,12 +275,15 @@ def test_local_chain_byte_identical_no_codec_work(chain3):
     """All-colocated chain: every hop negotiates local, outputs are
     byte-identical to the all-TCP chain, and — the satellite regression
     — ZERO ``codec.*`` histogram samples are recorded on local hops
-    (the raw path previously paid encode+decode even in-process)."""
+    (the raw path previously paid encode+decode even in-process).
+    ``tier="local"`` pins the local rung: ``auto``'s top rung is now
+    the device-resident ici tier (tests/test_ici.py), which would win
+    every same-process hop here."""
     g, params, stages, xs, base, base_stats = chain3
     assert [s["tier"] for s in base_stats] == ["tcp"] * 3
     enc0, dec0 = _hist_count("codec.encode_s"), _hist_count("codec.decode_s")
     lf0 = _counter("transport.local_frames")
-    outs, stats = _run_chain_inproc(stages, params, xs, tier="auto")
+    outs, stats = _run_chain_inproc(stages, params, xs, tier="local")
     assert [s["tier"] for s in stats] == ["local"] * 3
     assert [s["tier_in"] for s in stats] == ["local"] * 3
     for a, b in zip(base, outs):
@@ -297,7 +300,7 @@ def test_mixed_tier_chain_byte_identical(chain3):
     g, params, stages, xs, base, _ = chain3
     outs, stats = _run_chain_inproc(
         stages, params, xs, tier="tcp",
-        node_tiers=["auto", "tcp", "auto"])
+        node_tiers=["local", "tcp", "local"])
     # hop s0->s1 local; s1->s2 stays tcp; s2->result refused by the
     # tcp-tier dispatcher (tier_accept=False) -> degrades to tcp
     assert [s["tier"] for s in stats] == ["local", "tcp", "tcp"]
@@ -311,7 +314,7 @@ def test_claimed_colocation_degrades_to_tcp(chain3):
     byte-identical, and ``transport.tier_fallback`` increments."""
     g, params, stages, xs, base, _ = chain3
     before = _counter("transport.tier_fallback")
-    outs, stats = _run_chain_inproc(stages, params, xs, tier="auto",
+    outs, stats = _run_chain_inproc(stages, params, xs, tier="local",
                                     accepts=[True, False, True])
     assert _counter("transport.tier_fallback") > before
     by_stage = {s["stage"]: s["tier"] for s in stats}

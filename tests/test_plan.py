@@ -175,7 +175,10 @@ def test_replicated_comm_model_fan_parallelism():
     spec = CodecSpec("x", ratio=2.0, encode_bytes_per_s=1e6,
                      decode_bytes_per_s=2e6)
     g = dense_chain([256, 16], in_width=16)
-    cm = StageCostModel(g, gen="v4", link_bw_s=1e6, codecs={"x": spec})
+    # host_sync_bw_s=0: this test checks the pure codec fan algebra
+    # (the host-sync halves ride enc/dec and would shift the constants)
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e6, codecs={"x": spec},
+                        host_sync_bw_s=0)
     raw = cm.cut_bytes("fc0")
     enc, wire, dec = cm.comm_parts("fc0", "x")
     assert enc == pytest.approx(raw / 1e6)
@@ -383,7 +386,8 @@ def test_replan_moves_cut_toward_measured_hotspot():
     # nanosecond-scale roofline of a toy dense chain
     g = dense_chain([512] * 8, in_width=512)
     free = {"raw": CodecSpec("raw", 1.0, 1e15, 1e15)}
-    cm = StageCostModel(g, gen="v4", link_bw_s=1e13, codecs=free)
+    cm = StageCostModel(g, gen="v4", link_bw_s=1e13, codecs=free,
+                        host_sync_bw_s=0)
     plan = solve(g, 2, cm)
     assert plan.cuts == ["fc3"]  # balanced 4/4 before telemetry lands
     pred0 = cm.compute_seconds(

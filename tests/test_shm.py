@@ -511,13 +511,18 @@ def test_solver_exploits_shm_hop_tier_map():
 
 def test_shm_costs_between_local_and_wire():
     """The ladder's preference order falls out of the model: local
-    (one pass) < shm (two passes) < any wire codec on a fat boundary."""
+    (one pass + host sync) < shm (two passes + host sync) < any wire
+    codec on a fat boundary — the tiers differ by exactly one
+    memory-bandwidth pass over the boundary bytes (the host_sync
+    round-trip they BOTH pay is the part the ici tier removes,
+    tests/test_ici.py)."""
     g, cm = _fat_boundary_model()
     local_s = cm.with_hop_tiers({"d1": "local"}).comm_seconds("d1", "local")
     shm_s = cm.with_hop_tiers({"d1": "shm"}).comm_seconds("d1", "shm")
     wire_s = cm.best_codec("d1")[1]
     assert 0.0 < local_s < shm_s < wire_s
-    assert shm_s == pytest.approx(2 * local_s)
+    assert shm_s - local_s == pytest.approx(
+        cm.cut_bytes("d1") / cm.local_bw_s)
 
 
 def test_shm_tier_never_applies_to_fan_hops():
