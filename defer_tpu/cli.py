@@ -55,11 +55,11 @@ def _add_obs_flags(p):
                         "(counters, byte counts, latency percentiles)")
 
 
-def _obs_begin(args):
+def _obs_begin(args, *, process: str = "dispatcher"):
     """Enable the process tracer when a trace export was requested."""
     if getattr(args, "trace_out", None):
         from .obs import enable_tracing
-        enable_tracing(process="dispatcher").start_trace()
+        enable_tracing(process=process).start_trace()
 
 
 def _obs_finish(args, extra: dict | None = None):
@@ -993,6 +993,12 @@ def cmd_serve(args):
     params = graph.init(jax.random.key(0))
     tenants = _parse_tenant_specs(args.tenant)
     _start_prom(args, "serve")
+    # request-scoped tracing composes with serving (docs/SERVING.md):
+    # --trace-out enables the tracer, --trace-sample N samples whole
+    # REQUESTS 1-in-N (every frame of a sampled request traces end to
+    # end across the front door AND every stage process)
+    _obs_begin(args, process="serve")
+    ext_addrs: list[str] = []
 
     if args.workload == "decode":
         from .serve import ContinuousBatchEngine
@@ -1034,6 +1040,14 @@ def cmd_serve(args):
             disp = ChainDispatcher(addrs[0], codec=args.codec)
             disp.deploy(stages, params, addrs, batch=width,
                         codecs=hop_codecs)
+            ext_addrs = addrs
+            from .obs import tracer
+            if tracer().enabled:
+                # external stage processes: re-anchor their tracers so
+                # a sampled request's cross-process waterfall lands on
+                # one Perfetto timeline (the dispatcher edge of clock
+                # alignment, docs/OBSERVABILITY.md)
+                disp.align_clocks(addrs)
             cleanup = lambda: None  # noqa: E731 — nodes are external
         else:
             # self-contained deployment: thread-per-stage nodes in this
@@ -1056,7 +1070,8 @@ def cmd_serve(args):
                     t.join(timeout=10)
         backend = ChainBackend(disp, width,
                                tuple(stages[0].in_spec.shape),
-                               window=args.window)
+                               window=args.window,
+                               trace_sample_every=args.trace_sample)
         door = ServeFrontDoor(backend=backend, listen=args.listen,
                               tenants=tenants,
                               gather_s=args.gather_ms / 1e3)
@@ -1078,8 +1093,19 @@ def cmd_serve(args):
     except KeyboardInterrupt:
         pass
     finally:
+        from .obs import tracer
+        if tracer().enabled and ext_addrs:
+            # stitch the external stage processes' spans in while they
+            # are still alive (in-process thread nodes already share
+            # this tracer, so only --nodes chains need the collection)
+            try:
+                disp.collect_trace(ext_addrs)
+            except Exception as e:  # noqa: BLE001 — advisory
+                print(f"serve: trace collection failed: {e!r}",
+                      file=sys.stderr, flush=True)
         door.stop()
         cleanup()
+        _obs_finish(args)
         print(json.dumps({"final_stats": door.stats()}), flush=True)
 
 
@@ -1121,15 +1147,31 @@ def _render_serve_stats(doc: dict) -> None:
           f"inflight={doc.get('inflight')} service~"
           f"{doc.get('service_estimate_ms')}ms")
     print(f"{'TENANT':>12} {'W':>5} {'PRI':>3} {'QUEUED':>6} {'ADM':>7} "
-          f"{'SHED':>6} {'DONE':>7} {'QDELAY P50':>11} {'P99 MS':>8}")
+          f"{'SHED':>6} {'DONE':>7} {'QDELAY P50':>11} {'P99 MS':>8} "
+          f"{'SLO%':>6}")
+    attrib = doc.get("attribution") or {}
     for name, r in (doc.get("tenants") or {}).items():
         qd = r.get("queue_delay_s") or {}
         p50 = (qd.get("p50", 0.0) or 0.0) * 1e3 if qd.get("count") else 0.0
         p99 = (qd.get("p99", 0.0) or 0.0) * 1e3 if qd.get("count") else 0.0
+        # SLO attainment: fraction of DELIVERED units inside the
+        # tenant's deadline_ms ("-" = no deadline / nothing scored yet)
+        att = r.get("slo_attainment")
+        att_s = "-" if att is None else f"{att * 100:.1f}"
         print(f"{name:>12} {r.get('weight', 1):>5.1f} "
               f"{r.get('priority', 0):>3} {r.get('queued', 0):>6} "
               f"{r.get('admitted', 0):>7} {r.get('shed', 0):>6} "
-              f"{r.get('completed', 0):>7} {p50:>11.3f} {p99:>8.3f}")
+              f"{r.get('completed', 0):>7} {p50:>11.3f} {p99:>8.3f} "
+              f"{att_s:>6}")
+        # where the tenant's latency goes: the door's always-on
+        # attribution buckets (p50 ms per bucket, docs/OBSERVABILITY.md)
+        buckets = attrib.get(name)
+        if buckets and (buckets.get("e2e") or {}).get("count"):
+            parts = " ".join(
+                f"{k}={((buckets.get(k) or {}).get('p50', 0.0)):.2f}"
+                for k in ("admission", "gather", "chain", "result_edge"))
+            print(f"{'':>12}   p50ms: {parts} "
+                  f"e2e={(buckets['e2e'].get('p50', 0.0)):.2f}")
 
 
 def cmd_monitor(args):
@@ -1163,11 +1205,36 @@ def cmd_monitor(args):
         view.connect(addrs, interval_ms=args.interval_ms,
                      align_clocks=args.align,
                      timeout_s=args.connect_timeout)
+    door_ev_cursor = 0
+    door_ev_dropped = 0
     try:
         i = 0
         while True:
             time.sleep(args.interval_ms / 1e3)
             i += 1
+            events = None
+            if args.events:
+                # the merged flight-recorder log, incremental: node
+                # events arrive on the obs_push stream (drained from
+                # the view), the front door's over an events_since
+                # observer round-trip (docs/OBSERVABILITY.md)
+                from .obs.events import merge_events
+                batch = view.take_events()
+                if args.serve:
+                    from .serve.client import fetch_events
+                    h, _, p = args.serve.rpartition(":")
+                    try:
+                        rep = fetch_events(
+                            h or "127.0.0.1", int(p),
+                            cursor=door_ev_cursor,
+                            timeout_s=args.connect_timeout)
+                        batch += rep.get("events") or []
+                        door_ev_cursor = rep.get("cursor",
+                                                 door_ev_cursor)
+                        door_ev_dropped = rep.get("dropped", 0)
+                    except (OSError, ConnectionError):
+                        pass
+                events = merge_events(batch)
             serve_doc = None
             if args.serve:
                 from .serve.client import fetch_stats
@@ -1193,6 +1260,10 @@ def cmd_monitor(args):
                        "clock_offsets": {
                            a: round(v["offset_us"], 1)
                            for a, v in view.clock_offsets.items()}}
+                if events is not None:
+                    doc["events"] = events
+                    doc["events_dropped"] = (view.events_dropped
+                                             + door_ev_dropped)
                 if serve_doc is not None:
                     serve_doc.pop("cmd", None)
                     doc["serve"] = serve_doc
@@ -1204,6 +1275,16 @@ def cmd_monitor(args):
             else:
                 _render_monitor(rows, bott, flags, view.clock_offsets,
                                 clear=i > 1)
+                if events:
+                    for ev in events[-16:]:
+                        data = " ".join(f"{k}={v}" for k, v in
+                                        sorted(ev["data"].items()))
+                        print(f"event: [{ev['kind']}] {ev['proc']}"
+                              f"#{ev['seq']} {data}")
+                    dropped = view.events_dropped + door_ev_dropped
+                    if dropped:
+                        print(f"event: ({dropped} dropped ring-wide — "
+                              f"raise DEFER_EVENTS_CAP)")
                 if serve_doc is not None:
                     _render_serve_stats(serve_doc)
                 if suggestion is not None:
@@ -1609,7 +1690,19 @@ def main(argv=None):
                     help="decode mode: default tokens per request")
     sv.add_argument("--seconds", type=float, default=0.0,
                     help="serve for N seconds then exit (0 = forever)")
-    sv.add_argument("--prom-port", type=int, default=None, metavar="PORT")
+    sv.add_argument("--prom-port", type=int, default=None, metavar="PORT",
+                    help="serve this process's metrics registry — front-"
+                         "door admission/shed/completion counters and "
+                         "per-tenant histograms included — as a "
+                         "Prometheus scrape endpoint on PORT")
+    sv.add_argument("--trace-sample", type=int, default=0, metavar="N",
+                    help="with --trace-out: request-scoped waterfall "
+                         "sampling — 1-in-N formed frames (and every "
+                         "request riding them) record spans end to end "
+                         "across the front door and every stage "
+                         "process, on one clock-aligned timeline "
+                         "(docs/OBSERVABILITY.md)")
+    _add_obs_flags(sv)
     _add_cost_flags(sv)
 
     sc = sub.add_parser("serve-client", help="open-loop load generator "
@@ -1670,7 +1763,16 @@ def main(argv=None):
     mo.add_argument("--serve", default="", metavar="host:port",
                     help="also poll a serve front door's stats endpoint "
                          "and render per-tenant columns (admitted / "
-                         "shed / queue-delay percentiles)")
+                         "shed / queue-delay percentiles / SLO "
+                         "attainment / attribution buckets)")
+    mo.add_argument("--events", action="store_true",
+                    help="render the merged flight-recorder event log "
+                         "(sheds, tier negotiations/fallbacks, "
+                         "straggler flags, replan suggestions, node "
+                         "deaths, stream/client lifecycle) from every "
+                         "watched node's obs_push stream and — with "
+                         "--serve — the front door's events_since "
+                         "endpoint (docs/OBSERVABILITY.md)")
     mo.add_argument("--align", action="store_true",
                     help="actively clock-ALIGN every node's tracer to "
                          "this process (default: passively estimate "

@@ -22,6 +22,11 @@ from .histogram import LatencyHistogram
 from .registry import REGISTRY, Counter, Gauge, MetricsRegistry, get_registry
 from .trace import (Tracer, enable_tracing, export_chrome_trace,
                     new_span_id, tracer, trace_context)
+from .events import (EVENT_KINDS, FlightRecorder, merge_events,
+                     recorder, validate_event)
+from .events import emit as emit_event
+from .attrib import (DoorAttribution, RequestAttribution,
+                     attribute_request, attribute_sampled)
 from .cluster import (ClusterView, StragglerDetector, StragglerFlag,
                       align_clock, estimate_clock_offset,
                       expected_stage_ms)
@@ -32,6 +37,10 @@ __all__ = [
     "MetricsRegistry", "REGISTRY", "get_registry", "Counter", "Gauge",
     "Tracer", "tracer", "enable_tracing", "export_chrome_trace",
     "trace_context", "new_span_id",
+    "FlightRecorder", "recorder", "emit_event", "merge_events",
+    "validate_event", "EVENT_KINDS",
+    "RequestAttribution", "attribute_request", "attribute_sampled",
+    "DoorAttribution",
     "ClusterView", "StragglerDetector", "StragglerFlag",
     "estimate_clock_offset", "align_clock", "expected_stage_ms",
     "ObsReporter", "start_prom_server",

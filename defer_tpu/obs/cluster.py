@@ -38,6 +38,8 @@ import dataclasses
 import threading
 import time
 
+from .events import emit as emit_event
+from .events import merge_events, recorder
 from .trace import Tracer, tracer
 
 #: a queue watermark at >= this fraction of its depth counts as saturated
@@ -129,13 +131,14 @@ def _service_ms(push: dict) -> float:
 class _Node:
     """Rolling per-node state: identity + a bounded push history."""
 
-    __slots__ = ("ident", "addr", "history", "err")
+    __slots__ = ("ident", "addr", "history", "err", "events_dropped")
 
     def __init__(self, ident: dict, addr: str | None, history: int):
         self.ident = ident
         self.addr = addr
         self.history: collections.deque = collections.deque(maxlen=history)
         self.err: BaseException | None = None
+        self.events_dropped = 0
 
 
 class ClusterView:
@@ -146,12 +149,20 @@ class ClusterView:
     with ``obs_push`` payloads directly (tests, embedded dispatchers).
     """
 
-    def __init__(self, *, history: int = 240, span_buffer: int = 4096):
+    def __init__(self, *, history: int = 240, span_buffer: int = 4096,
+                 event_buffer: int = 4096):
         self._lock = threading.Lock()
         self._nodes: dict = {}
         self._history = history
         self._spans: collections.deque = collections.deque(
             maxlen=span_buffer)
+        #: cluster-merged flight-recorder events, arrival order
+        #: (obs/events.py rides the obs_push frames here)
+        self._events: collections.deque = collections.deque(
+            maxlen=event_buffer)
+        #: sum of every node's reported ring evictions (a nonzero total
+        #: means the merged log has gaps — surfaced by monitor --events)
+        self.events_dropped = 0
         self._socks: list = []
         self._threads: list[threading.Thread] = []
         self._closed = threading.Event()
@@ -179,6 +190,15 @@ class ClusterView:
             node.history.append((time.monotonic(), push))
             spans = (push.get("trace") or {}).get("spans") or ()
             self._spans.extend(spans)
+            ev_doc = push.get("events") or {}
+            self._events.extend(ev_doc.get("events") or ())
+            dropped = ev_doc.get("dropped")
+            if dropped is not None:
+                # per-node lifetime counts: keep the max seen per node
+                node.events_dropped = int(dropped)
+                self.events_dropped = sum(
+                    getattr(nd, "events_dropped", 0)
+                    for nd in self._nodes.values())
 
     def connect(self, addrs, *, interval_ms: float = 250.0,
                 spans: bool = False, span_limit: int = 256,
@@ -241,6 +261,12 @@ class ClusterView:
                 for node in self._nodes.values():
                     if node.addr == addr:
                         node.err = e
+            if not self._closed.is_set():
+                # a node dying mid-watch is itself a flight-recorder
+                # fact: it lands in THIS process's ring and therefore in
+                # the merged log (the dead node can no longer push)
+                self._events.append(emit_event(
+                    "node_dead", addr=addr, error=repr(e)))
 
     def close(self) -> None:
         """Unsubscribe (best-effort END) and drop every connection."""
@@ -380,6 +406,32 @@ class ClusterView:
         with self._lock:
             return list(self._spans)
 
+    def events(self, *, include_local: bool = True) -> list[dict]:
+        """The cluster-merged flight-recorder log: every watched node's
+        pushed events (plus, by default, this process's own ring — a
+        dispatcher/front door colocated with the monitor) ordered by
+        the clock-aligned timestamp with per-process seq as the tie
+        break (:func:`~defer_tpu.obs.events.merge_events`)."""
+        with self._lock:
+            batch = list(self._events)
+        if include_local:
+            # the view's node_dead markers are already copies of local
+            # ring entries — dedup on (proc, seq)
+            seen = {(e.get("proc"), e.get("seq")) for e in batch}
+            batch += [e for e in recorder().snapshot()
+                      if (e.get("proc"), e.get("seq")) not in seen]
+        return merge_events(batch)
+
+    def take_events(self) -> list[dict]:
+        """Drain the NODE-pushed events accumulated since the last call
+        (arrival order) — the monitor's incremental read; merge with
+        :func:`merge_events` per batch when rendering."""
+        out = []
+        with self._lock:
+            while self._events:
+                out.append(self._events.popleft())
+        return out
+
     # -- bottleneck identification ----------------------------------------
 
     def _stage_map(self) -> dict[int, list[dict]]:
@@ -507,6 +559,9 @@ class StragglerDetector:
         self.expected_ms = list(expected_ms) if expected_ms else None
         self.factor = factor
         self.sustain = max(1, sustain)
+        #: (stage, reason) pairs already emitted into the flight
+        #: recorder — a sustained flag is ONE event, not one per poll
+        self._emitted: set[tuple[int, str]] = set()
 
     def _stage_history(self, view: ClusterView) -> dict[int, list[list]]:
         """stage -> per-replica push histories (newest last)."""
@@ -600,7 +655,17 @@ class StragglerDetector:
                 flags[k] = StragglerFlag(
                     stage=k, reason="stalled", measured_ms=0.0,
                     expected_ms=0.0, ratio=0.0, intervals=k_sust)
-        return [flags[k] for k in sorted(flags)]
+        out = [flags[k] for k in sorted(flags)]
+        live = set()
+        for f in out:
+            key = (f.stage, f.reason)
+            live.add(key)
+            if key not in self._emitted:
+                self._emitted.add(key)
+                emit_event("straggler", **f.to_json())
+        # a flag that clears re-arms its event for the next episode
+        self._emitted &= live
+        return out
 
     def suggest(self, view: ClusterView, graph, plan, cost=None):
         """Feed the live measurements into the replanner: returns the
@@ -621,4 +686,8 @@ class StragglerDetector:
         # and the re-solve would pile work onto the dead stage
         measured = {k: v / 1e3
                     for k, v in view.stage_service_ms().items() if v > 0}
-        return replan(graph, plan, measured, cost)
+        result = replan(graph, plan, measured, cost)
+        emit_event("replan", moved=bool(result.moved),
+                   corrections={str(k): round(float(v), 4)
+                                for k, v in result.corrections.items()})
+        return result

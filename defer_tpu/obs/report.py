@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 
+from .events import recorder
 from .registry import REGISTRY
 from .trace import tracer
 
@@ -97,9 +98,38 @@ class ObsReporter(threading.Thread):
         # checked — shadowing it with an Event breaks that call
         self._halt = threading.Event()
         self._cursor = tracer().span_cursor()
+        #: flight-recorder cursor: pushes carry only events emitted
+        #: since the subscription instant (obs/events.py)
+        self._ev_cursor = recorder().cursor()
         #: per-subscriber identity for the source's watermark splitter
         #: (each subscription sees peaks since ITS own last push)
         self.sid = id(self)
+
+    def _snapshot(self):
+        """One source snapshot, tolerant of the source's vintage: the
+        current contract returns ``(payload, span_cursor,
+        event_cursor)``; older sources (tests, external stubs) may
+        return two values or reject the newer keywords."""
+        try:
+            out = self._source.obs_snapshot(
+                cursor=self._cursor, include_spans=self._spans,
+                span_limit=self._span_limit, subscriber=self.sid,
+                event_cursor=self._ev_cursor)
+        except TypeError:
+            try:
+                out = self._source.obs_snapshot(
+                    cursor=self._cursor, include_spans=self._spans,
+                    span_limit=self._span_limit, subscriber=self.sid)
+            except TypeError:
+                # source predates per-subscriber watermark splitting
+                out = self._source.obs_snapshot(
+                    cursor=self._cursor, include_spans=self._spans,
+                    span_limit=self._span_limit)
+        if len(out) == 3:
+            payload, self._cursor, self._ev_cursor = out
+        else:
+            payload, self._cursor = out
+        return payload
 
     def run(self) -> None:
         from ..transport.framed import send_ctrl
@@ -109,16 +139,7 @@ class ObsReporter(threading.Thread):
         seq = 0
         try:
             while not self._halt.is_set():
-                try:
-                    payload, self._cursor = self._source.obs_snapshot(
-                        cursor=self._cursor, include_spans=self._spans,
-                        span_limit=self._span_limit,
-                        subscriber=self.sid)
-                except TypeError:
-                    # source predates per-subscriber watermark splitting
-                    payload, self._cursor = self._source.obs_snapshot(
-                        cursor=self._cursor, include_spans=self._spans,
-                        span_limit=self._span_limit)
+                payload = self._snapshot()
                 try:
                     payload["cmd"] = "obs_push"
                     payload["push_seq"] = seq

@@ -34,6 +34,18 @@ from .registry import REGISTRY
 #: visible price of the cap (docs/OBSERVABILITY.md, overhead notes)
 _DROPPED = REGISTRY.counter("trace.dropped_spans")
 
+#: callbacks invoked with ``delta_us`` whenever the process tracer's
+#: wall anchor shifts (clock alignment): other timeline-stamped buffers
+#: — the flight recorder's event ring (obs/events.py) — register here
+#: so their buffered entries stay coherent with the shifted spans
+_ANCHOR_HOOKS: list = []
+
+
+def register_anchor_hook(fn) -> None:
+    """Register ``fn(delta_us)`` to run on every wall-anchor shift of
+    the process tracer."""
+    _ANCHOR_HOOKS.append(fn)
+
 
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]
@@ -252,6 +264,12 @@ class Tracer:
         self._wall0_us += delta_us
         for s in list(self._spans):
             s["ts_us"] += delta_us
+        if self is _TRACER:
+            # coupled timeline buffers (the flight recorder) shift with
+            # the PROCESS tracer only — test-local Tracer instances must
+            # not drag the process event ring around
+            for fn in _ANCHOR_HOOKS:
+                fn(delta_us)
 
     # -- cross-process stitching -------------------------------------------
 

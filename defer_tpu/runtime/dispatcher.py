@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..graph.ir import LayerGraph
 from ..obs import REGISTRY, tracer
+from ..obs.events import emit as emit_event
 from ..parallel.mesh import pipeline_mesh
 from ..partition.partitioner import partition
 from ..utils.config import DeferConfig
@@ -818,6 +819,10 @@ class Defer:
                             handle.recoveries += 1
                             handle._gen += 1
                             handle._busy_since = None
+                            emit_event("watchdog", action="recover",
+                                       gen=handle._gen,
+                                       stalled_s=round(
+                                           time.monotonic() - busy, 3))
                             replay = list(handle._resubmit)
                             handle._resubmit.clear()
                             try:
@@ -833,6 +838,10 @@ class Defer:
                         # out of recoveries (or MPMD): a dead device/backend
                         # surfaces instead of the reference's forever-hang
                         # (SURVEY.md §5 failure row)
+                        emit_event("watchdog", action="dead",
+                                   gen=handle._gen,
+                                   stalled_s=round(
+                                       time.monotonic() - busy, 3))
                         handle.error = TimeoutError(
                             f"pipeline dispatch made no progress for "
                             f"{wd:.1f}s; deployment declared dead")

@@ -374,12 +374,16 @@ class StageNode:
                     and self.branch is None:
                 # branch-path hops never probe: the join end is wire-
                 # framed by design (ordered (path, seq) merge)
+                from ..obs.events import emit as emit_event
                 from ..transport.shm import offer_tier_ladder
                 self.tier_out, tx, fell_back = offer_tier_ladder(
                     socks[0], tier=self.tier, depth=self.tx_depth,
                     hop=self._span_label(), device=self._jax_device())
                 if fell_back:
                     self.tier_fallbacks += 1
+                emit_event("tier", hop=self._span_label(),
+                           tier=self.tier_out, wanted=self.tier,
+                           fallback=bool(fell_back))
             if tx is None:
                 self.tier_out = "tcp"
                 tx = AsyncSender(socks[0], depth=self.tx_depth,
@@ -567,6 +571,18 @@ class StageNode:
                                if r.is_alive()] + [rep]
             rep.start()
             return True
+        if cmd == "events_since":
+            # flight-recorder query (docs/OBSERVABILITY.md): the events
+            # emitted in THIS process since the caller's cursor, without
+            # draining what obs_push subscribers read incrementally
+            from ..obs.events import recorder
+            rec = recorder()
+            cursor, evs = rec.events_since(
+                int(msg.get("cursor", 0)),
+                limit=int(msg.get("limit", 512)))
+            send_ctrl(conn, {"cmd": "events_reply", "events": evs,
+                             "cursor": cursor, "dropped": rec.dropped})
+            return True
         if cmd == "trace_dump":
             tr = tracer()
             send_ctrl(conn, {"spans": tr.drain()})
@@ -688,10 +704,14 @@ class StageNode:
 
     def obs_snapshot(self, *, cursor: int = 0, include_spans: bool = True,
                      span_limit: int = 256,
-                     subscriber: int | None = None) -> tuple[dict, int]:
+                     subscriber: int | None = None,
+                     event_cursor: int = 0, event_limit: int = 128
+                     ) -> tuple[dict, int, int]:
         """One ``obs_push`` payload: identity, lifetime counters, queue
         depths + per-interval watermarks (reset on read), cumulative
-        latency summaries, and — when tracing is live — the spans
+        latency summaries, the flight recorder's events since
+        ``event_cursor`` (obs/events.py — how node events reach the
+        cluster-merged log), and — when tracing is live — the spans
         recorded since ``cursor`` (without draining what ``trace_dump``
         collects at stream end).  Called by :class:`ObsReporter` on its
         own thread; everything read here is either an attribute or a
@@ -765,7 +785,12 @@ class StageNode:
             cursor, spans = tr.spans_since(cursor, limit=span_limit)
             trace_doc["spans"] = spans
         payload["trace"] = trace_doc
-        return payload, cursor
+        from ..obs.events import recorder
+        rec = recorder()
+        event_cursor, evs = rec.events_since(event_cursor,
+                                             limit=event_limit)
+        payload["events"] = {"dropped": rec.dropped, "events": evs}
+        return payload, cursor, event_cursor
 
     def serve(self, *, connect_timeout_s: float = 30.0) -> int:
         """Serve control/data connections until a data stream completes.
@@ -944,6 +969,9 @@ class StageNode:
                         # END + join: every relayed frame is on the wire
                         # before the finally block closes the socket
                         tx.close(timeout=connect_timeout_s)
+                        from ..obs.events import emit as emit_event
+                        emit_event("stream_end", hop=self._span_label(),
+                                   n=n)
                         return n
                     return None  # control connection closing
                 if kind == K_CTRL:
@@ -1023,6 +1051,8 @@ class StageNode:
                     rx.bind_hist("node.rx_s")
                     rx.sample_every = self.trace_sample_every
                     self._live_rx = rx
+                    from ..obs.events import emit as emit_event
+                    emit_event("stream_begin", hop=self._span_label())
                 want = tuple(self.manifest["in_shape"])
                 if tuple(value.shape[1:]) != want:
                     raise ValueError(
@@ -1706,6 +1736,7 @@ class ChainDispatcher:
                     # process) over shm (same host) over tcp; a
                     # cross-host node refuses everything and we stay
                     # on tcp with one fallback counted
+                    from ..obs.events import emit as emit_event
                     from ..transport.shm import offer_tier_ladder
                     self.tier_out, self._tx_chan, fell_back = \
                         offer_tier_ladder(self._send_sock,
@@ -1714,6 +1745,10 @@ class ChainDispatcher:
                                           hop="chain")
                     if fell_back:
                         self.tier_fallbacks += 1
+                    emit_event("tier", hop="chain",
+                               tier=self.tier_out or "tcp",
+                               wanted=self.tier,
+                               fallback=bool(fell_back))
                 if self._tx_chan is None:
                     self.tier_out = "tcp"
                     self._tx_chan = AsyncSender(
@@ -2066,6 +2101,32 @@ class ChainDispatcher:
             return kind, y
 
     # -- serve front door: request-scoped duplex stream --------------------
+
+    def begin_trace(self, *, sample_every: int | None = None
+                    ) -> str | None:
+        """Inject the current trace context into the chain ahead of any
+        request-scoped frame — the serving-path twin of what
+        :meth:`stream` does per call.  A front door has no stream()
+        call, so its backend calls this once at start: every stage
+        adopts the trace, cascades it downstream, and samples the SAME
+        1-in-N wire seqs (``sample_every`` rides the context exactly
+        like ``--trace-sample``).  Returns the pre-allocated root span
+        id stage spans parent under, or None when tracing is off."""
+        tr = tracer()
+        if not tr.enabled:
+            return None
+        if sample_every is not None:
+            self.trace_sample_every = max(0, int(sample_every))
+        self._ensure_connected()
+        self._tx_chan.sample_every = self.trace_sample_every
+        if self._rx_chan is not None:
+            self._rx_chan.sample_every = self.trace_sample_every
+        root_span = new_span_id()
+        self._tx_chan.send_ctrl(
+            {"cmd": "trace", "trace_id": tr.trace_id,
+             "span_id": root_span,
+             "sample_every": self.trace_sample_every})
+        return root_span
 
     def send_request_frame(self, arr: np.ndarray, *, seq: int,
                            meta: dict | None = None) -> None:

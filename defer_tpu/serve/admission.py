@@ -27,6 +27,7 @@ import time
 from typing import Any, Callable
 
 from ..obs import REGISTRY
+from ..obs.events import emit as emit_event
 
 
 @dataclasses.dataclass
@@ -211,7 +212,8 @@ class AdmissionController:
         self.queue.configure(cfg)
         # instantiate the per-tenant instruments up front so a tenant
         # that only ever gets shed still shows up in stats
-        for c in ("admitted", "shed", "completed"):
+        for c in ("admitted", "shed", "completed", "slo_measured",
+                  "slo_ok"):
             REGISTRY.counter(f"serve.tenant.{cfg.name}.{c}")
         REGISTRY.histogram(f"serve.tenant.{cfg.name}.queue_delay_s")
 
@@ -291,15 +293,24 @@ class AdmissionController:
         else:
             dec = ShedDecision(True, predicted)
         t_cfg = cfg.name
+        rid = getattr(item, "rid", None)
+        if rid is None:
+            rid = getattr(item, "request_id", None)
         if dec.admitted:
             with self._lock:
                 self.inflight += 1
             self.queue.push(tenant, item)
             self._admit_total.n += 1
             REGISTRY.counter(f"serve.tenant.{t_cfg}.admitted").n += 1
+            emit_event("admit", tenant=t_cfg, rid=rid,
+                       backlog=backlog + 1)
         else:
             self._shed_total.n += 1
             REGISTRY.counter(f"serve.tenant.{t_cfg}.shed").n += 1
+            emit_event("shed", tenant=t_cfg, rid=rid,
+                       reason=dec.reason,
+                       predicted_ms=round(dec.predicted_s * 1e3, 3),
+                       retry_after_ms=round(dec.retry_after_s * 1e3, 3))
         return dec
 
     def complete(self, tenant: str, *, queued_at: float | None = None,
@@ -316,6 +327,22 @@ class AdmissionController:
             REGISTRY.histogram(
                 f"serve.tenant.{tenant}.queue_delay_s").record(dt)
 
+    def record_slo(self, tenant: str, e2e_s: float) -> None:
+        """Score one DELIVERED unit against its tenant's deadline —
+        the per-tenant SLO-attainment fraction ``monitor --serve``
+        renders.  Units dropped with a dead client are never scored
+        (they have no delivery latency), so attainment measures what
+        tenants actually experienced."""
+        try:
+            cfg = self.tenant(tenant)
+        except KeyError:
+            return
+        if cfg.deadline_ms is None:
+            return
+        REGISTRY.counter(f"serve.tenant.{tenant}.slo_measured").n += 1
+        if e2e_s * 1e3 <= cfg.deadline_ms:
+            REGISTRY.counter(f"serve.tenant.{tenant}.slo_ok").n += 1
+
     # -- observability -----------------------------------------------------
 
     def stats(self) -> dict:
@@ -325,6 +352,9 @@ class AdmissionController:
             inflight = self.inflight
         rows = {}
         for name, cfg in sorted(tenants.items()):
+            measured = REGISTRY.counter(
+                f"serve.tenant.{name}.slo_measured").value
+            ok = REGISTRY.counter(f"serve.tenant.{name}.slo_ok").value
             rows[name] = {
                 "weight": cfg.weight, "priority": cfg.priority,
                 "deadline_ms": cfg.deadline_ms,
@@ -337,6 +367,11 @@ class AdmissionController:
                     f"serve.tenant.{name}.completed").value,
                 "queue_delay_s": REGISTRY.histogram(
                     f"serve.tenant.{name}.queue_delay_s").summary(),
+                # fraction of delivered units inside deadline_ms (None
+                # until a deadline tenant has deliveries to score)
+                "slo_attainment": (round(ok / measured, 4)
+                                   if measured else None),
+                "slo_measured": measured,
             }
         return {"tenants": rows, "inflight": inflight,
                 "queued": self.queue.qsize(),

@@ -57,12 +57,14 @@ class BatchFormer:
         first = self.queue.pop(timeout=timeout)
         if first is None:
             return []
+        _stamp_popped(first)
         out = [first]
         deadline = time.monotonic() + self.gather_s if self.gather_s \
             else None
         while len(out) < self.width:
             nxt = self.queue.pop(timeout=0.0)
             if nxt is not None:
+                _stamp_popped(nxt)
                 out.append(nxt)
                 continue
             if deadline is None or time.monotonic() >= deadline:
@@ -71,5 +73,17 @@ class BatchFormer:
                 timeout=max(0.0, deadline - time.monotonic()))
             if nxt is None:
                 break
+            _stamp_popped(nxt)
             out.append(nxt)
         return out
+
+
+def _stamp_popped(entry) -> None:
+    """Stamp the popped unit with the instant it left the admission
+    queue — the admission/gather boundary of per-request latency
+    attribution (``obs/attrib.py``).  Best-effort: units without the
+    slot (foreign test objects) simply go unstamped."""
+    try:
+        entry[1].popped_at = time.perf_counter()
+    except (AttributeError, TypeError, IndexError):
+        pass

@@ -121,6 +121,26 @@ def fetch_stats(host: str, port: int, *, timeout_s: float = 30.0) -> dict:
         sock.close()
 
 
+def fetch_events(host: str, port: int, *, cursor: int = 0,
+                 limit: int = 512, timeout_s: float = 30.0) -> dict:
+    """One flight-recorder round-trip against a front door: the door
+    process's events since ``cursor`` (``{"events", "cursor",
+    "dropped"}`` — pass the returned cursor back for the next
+    incremental batch).  The serving twin of a stage node's
+    ``{"cmd": "events_since"}`` control query."""
+    sock = connect_retry(host, port, timeout_s)
+    try:
+        send_ctrl(sock, {"cmd": "events_since", "cursor": int(cursor),
+                         "limit": int(limit)})
+        kind, msg = recv_frame(sock)
+        if kind != K_CTRL or msg.get("cmd") != "events_reply":
+            raise ConnectionError(f"expected events_reply, got {kind}")
+        send_end(sock)
+        return msg
+    finally:
+        sock.close()
+
+
 def _quantile(xs: list[float], q: float) -> float:
     if not xs:
         return 0.0

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from ..graph.ir import LayerGraph
 from ..models.gpt import CausalTransformerBlock, GptEmbedding
 from ..obs import REGISTRY
+from ..obs.events import emit as emit_event
 from ..runtime.decode import _sample_ids, _split_blocks
 
 
@@ -359,7 +360,9 @@ class EngineLoop(threading.Thread):
         with self._cancel_lock:
             cancels, self._cancel_q = self._cancel_q, []
         for req in cancels:
-            self.engine.cancel(req)
+            if self.engine.cancel(req):
+                emit_event("decode_cancel", rid=req.request_id,
+                           tenant=req.tenant)
 
     def run(self) -> None:
         eng = self.engine
@@ -375,9 +378,18 @@ class EngineLoop(threading.Thread):
                     item = queue.pop(timeout=timeout)
                     if item is None:
                         break
+                    # this loop pops the admission queue directly (no
+                    # BatchFormer.form), so the attribution boundary is
+                    # stamped here
+                    from .batcher import _stamp_popped
+                    _stamp_popped(item)
                     if getattr(item[1], "cancelled", False):
                         continue  # client left while it queued
-                    eng.join(item[1])
+                    if eng.join(item[1]):
+                        emit_event("decode_join",
+                                   rid=item[1].request_id,
+                                   tenant=item[1].tenant,
+                                   step=eng.steps)
                 if eng.active() == 0:
                     continue
                 t0 = time.perf_counter()

@@ -41,7 +41,11 @@ from typing import Any, Sequence
 
 import numpy as np
 
-from ..obs import REGISTRY
+from ..obs import REGISTRY, tracer
+from ..obs.attrib import DoorAttribution
+from ..obs.events import emit as emit_event
+from ..obs.events import recorder
+from ..transport.channel import _sampled
 from ..transport.framed import (K_CTRL, K_END, K_TENSOR, configure_socket,
                                 recv_frame, send_ctrl, send_end, send_frame)
 from .admission import AdmissionController, TenantConfig
@@ -71,7 +75,9 @@ class _Client:
 class _Unit:
     """One admitted sample (tensor mode)."""
 
-    __slots__ = ("client", "seq", "rid", "sample", "queued_at")
+    __slots__ = ("client", "seq", "rid", "sample", "queued_at",
+                 "queued_pc", "popped_at", "submitted_at", "demuxed_at",
+                 "sampled_seq")
 
     def __init__(self, client: _Client, seq: int, rid: int,
                  sample: np.ndarray):
@@ -80,6 +86,19 @@ class _Unit:
         self.rid = rid          #: door-global request id (demux key)
         self.sample = sample
         self.queued_at = time.monotonic()
+        #: the same instant on the tracer/attribution clock
+        #: (perf_counter) — plus the downstream waypoints the batch
+        #: former / backend stamp: popped from the admission queue,
+        #: frame submitted into the chain, frame back off the demux.
+        #: Together they tile the unit's timeline for the always-on
+        #: door attribution buckets (obs/attrib.py)
+        self.queued_pc = time.perf_counter()
+        self.popped_at: float | None = None
+        self.submitted_at: float | None = None
+        self.demuxed_at: float | None = None
+        #: frame wire seq when this request was trace-sampled (the
+        #: join key to the chain's stageK spans), else None
+        self.sampled_seq: int | None = None
 
 
 class ChainBackend:
@@ -99,10 +118,17 @@ class ChainBackend:
     """
 
     def __init__(self, dispatcher, width: int, in_shape: Sequence[int], *,
-                 window: int = 8):
+                 window: int = 8, trace_sample_every: int = 0):
         self.disp = dispatcher
         self.width = int(width)
         self.in_shape = tuple(in_shape)
+        #: request-scoped waterfall sampling (docs/OBSERVABILITY.md):
+        #: with tracing enabled, 1-in-N FRAMES — and therefore whole
+        #: requests, every unit of a sampled frame — record spans end
+        #: to end, keyed on the frame's wire seq that already rides
+        #: the chain (the same mechanism as ``chain --trace-sample``,
+        #: now composed with serving); 0 = every frame
+        self.trace_sample_every = max(0, int(trace_sample_every))
         self._window = threading.Semaphore(max(1, window))
         self._next_seq = 0
         self._pending: dict[int, dict[int, _Unit]] = {}
@@ -124,6 +150,12 @@ class ChainBackend:
         self.error: BaseException | None = None
 
     def start(self) -> None:
+        # trace composition happens BEFORE the demux reader exists:
+        # begin_trace cascades the trace context (and the shared
+        # sample_every) down the chain ahead of any request frame, so
+        # every stage samples the SAME 1-in-N wire seqs the door does
+        if tracer().enabled:
+            self.disp.begin_trace(sample_every=self.trace_sample_every)
         self._rx = threading.Thread(target=self._demux, daemon=True,
                                     name="serve-chain-demux")
         self._rx.start()
@@ -149,6 +181,26 @@ class ChainBackend:
             seq = self._next_seq
             self._next_seq += 1
             self._pending[seq] = {u.rid: u for u in live}
+        now = time.perf_counter()
+        tr = tracer()
+        if tr.enabled and _sampled(self.trace_sample_every, seq):
+            # a sampled FRAME samples every request riding it: the
+            # admission-wait and gather spans land on the same timeline
+            # (and under the same trace) as the chain's stageK spans
+            first_pop = min((u.popped_at for u in live
+                             if u.popped_at is not None), default=now)
+            tr.record("serve.gather", first_pop,
+                      max(now - first_pop, 0.0),
+                      {"seq": seq, "n": len(live)})
+            for u in live:
+                u.sampled_seq = seq
+                pop = u.popped_at if u.popped_at is not None else now
+                tr.record("serve.admission_wait", u.queued_pc,
+                          max(pop - u.queued_pc, 0.0),
+                          {"rid": u.rid, "tenant": u.client.tenant,
+                           "seq": seq})
+        for u in live:
+            u.submitted_at = now
         self.disp.send_request_frame(
             frame, seq=seq, meta={"slots": slots, "t": time.monotonic()})
         self._frames.n += 1
@@ -195,6 +247,7 @@ class ChainBackend:
                     self.on_service(max(1e-6, gap) / n_live, n_live)
                 self._prev_busy = still_busy
                 arr = np.asarray(arr)
+                now_pc = time.perf_counter()
                 for tenant, rid, cseq, row in meta["slots"]:
                     unit = units.pop(rid, None)
                     if unit is None:
@@ -205,6 +258,7 @@ class ChainBackend:
                         raise ConnectionError(
                             f"req_meta/unit mismatch on frame {seq}: "
                             f"{tenant}/{rid}/{cseq}")
+                    unit.demuxed_at = now_pc
                     if self.on_deliver is not None:
                         self.on_deliver(unit, arr[row])
                 self._window.release()
@@ -258,6 +312,10 @@ class ServeFrontDoor:
         self.former = BatchFormer(self.admission.queue, self.width,
                                   gather_s=gather_s)
         self.decode_defaults = dict(decode_defaults or {})
+        #: always-on per-tenant latency-attribution buckets (admission /
+        #: gather / chain / result edge — docs/OBSERVABILITY.md); rides
+        #: the stats reply for ``monitor --serve``
+        self.attrib = DoorAttribution()
         self._clients: list[_Client] = []
         self._lock = threading.Lock()
         self._halt = threading.Event()
@@ -342,17 +400,30 @@ class ServeFrontDoor:
             if kind != K_CTRL or not isinstance(value, dict):
                 raise ConnectionError("first frame must be a hello/stats "
                                       "control frame")
-            if value.get("cmd") == "stats":
-                # observer connection: reply stats per request until END
+            if value.get("cmd") in ("stats", "events_since"):
+                # observer connection: stats / flight-recorder queries
+                # per request until END
                 while True:
-                    send_ctrl(conn, {"cmd": "stats_reply",
-                                     **self.stats()})
+                    if value.get("cmd") == "events_since":
+                        rec = recorder()
+                        cursor, evs = rec.events_since(
+                            int(value.get("cursor", 0)),
+                            limit=int(value.get("limit", 512)))
+                        send_ctrl(conn, {"cmd": "events_reply",
+                                         "events": evs,
+                                         "cursor": cursor,
+                                         "dropped": rec.dropped})
+                    else:
+                        send_ctrl(conn, {"cmd": "stats_reply",
+                                         **self.stats()})
                     kind, value = recv_frame(conn)
                     if kind == K_END:
                         return
-                    if kind != K_CTRL or value.get("cmd") != "stats":
+                    if kind != K_CTRL or value.get("cmd") not in \
+                            ("stats", "events_since"):
                         raise ConnectionError(
-                            "observer connections speak stats/END only")
+                            "observer connections speak stats/"
+                            "events_since/END only")
             if value.get("cmd") != "hello":
                 raise ConnectionError(f"expected hello, got {value!r}")
             client = self._handle_hello(conn, value)
@@ -383,6 +454,7 @@ class ServeFrontDoor:
                     kw[k] = msg[k]
             kw.setdefault("max_new_tokens", 16)
             client.decode_kw = kw
+        emit_event("client_open", tenant=tenant, mode=self.mode)
         with self._lock:
             self._clients.append(client)
         send_ctrl(conn, {"cmd": "welcome", "mode": self.mode,
@@ -459,6 +531,8 @@ class ServeFrontDoor:
             seed=int(kw.get("seed", 0)),
             temperature=float(kw.get("temperature", 0.0)))
         req.queued_at = time.monotonic()
+        req.queued_pc = time.perf_counter()  # attribution clock twin
+        req.popped_at = None
 
         def on_done(tokens, _c=client, _s=seq, _r=req):
             self._deliver_decode(_c, _s, _r, tokens)
@@ -484,6 +558,36 @@ class ServeFrontDoor:
             except OSError as e:
                 self._disconnect(client, e)
                 return
+            done = time.perf_counter()
+            # always-on attribution + SLO scoring: the four stamped
+            # waypoints tile this unit's timeline exactly
+            self.admission.record_slo(client.tenant,
+                                      done - unit.queued_pc)
+            self.attrib.record(
+                client.tenant, queued=unit.queued_pc,
+                popped=unit.popped_at if unit.popped_at is not None
+                else unit.queued_pc,
+                submitted=unit.submitted_at
+                if unit.submitted_at is not None else unit.queued_pc,
+                demuxed=unit.demuxed_at
+                if unit.demuxed_at is not None else done,
+                delivered=done)
+            tr = tracer()
+            if tr.enabled and unit.sampled_seq is not None:
+                # the sampled request's result edge + root span close
+                # the trace: demux receipt -> client bytes written,
+                # then admitted -> delivered as the e2e envelope every
+                # child bucket telescopes inside
+                t_dx = unit.demuxed_at if unit.demuxed_at is not None \
+                    else done
+                tr.record("serve.deliver", t_dx, max(done - t_dx, 0.0),
+                          {"rid": unit.rid, "tenant": client.tenant,
+                           "seq": unit.sampled_seq})
+                tr.record("serve.request", unit.queued_pc,
+                          max(done - unit.queued_pc, 0.0),
+                          {"rid": unit.rid, "tenant": client.tenant,
+                           "seq": unit.sampled_seq,
+                           "client_seq": unit.seq})
         self._maybe_drained(client)
 
     def _deliver_decode(self, client: _Client, seq: int,
@@ -509,6 +613,18 @@ class ServeFrontDoor:
             except OSError as e:
                 self._disconnect(client, e)
                 return
+            done = time.perf_counter()
+            queued_pc = getattr(req, "queued_pc", done)
+            popped = getattr(req, "popped_at", None)
+            if popped is None:
+                popped = done
+            # decode buckets: admission = queue wait, chain = the
+            # engine's whole-request residency (its pipeline stages are
+            # in-process; no per-stage frame path to decompose)
+            self.admission.record_slo(client.tenant, done - queued_pc)
+            self.attrib.record(client.tenant, queued=queued_pc,
+                               popped=popped, submitted=popped,
+                               demuxed=done, delivered=done)
         self._maybe_drained(client)
 
     def _maybe_drained(self, client: _Client) -> None:
@@ -523,6 +639,8 @@ class ServeFrontDoor:
             if not client.alive:
                 return
             client.alive = False
+        emit_event("client_close", tenant=client.tenant,
+                   clean=bool(send_eos))
         try:
             if send_eos:
                 with client.wlock:
@@ -573,6 +691,12 @@ class ServeFrontDoor:
         doc = {"mode": self.mode, "width": self.width,
                "frames": REGISTRY.counter("serve.frames").value,
                "samples": REGISTRY.counter("serve.samples").value,
+               # per-tenant latency-attribution buckets (ms summaries)
+               # + the flight recorder's loss counter, so a monitor can
+               # see both what the p99 is made of and whether the event
+               # log under it is complete
+               "attribution": self.attrib.summary(),
+               "events_dropped": recorder().dropped,
                **self.admission.stats()}
         if self.engine is not None:
             doc["decode"] = {

@@ -75,6 +75,8 @@ def record_fallback(hop: str | None = None) -> None:
     _FALLBACK.n += 1
     if hop:
         REGISTRY.counter(f"transport.tier_fallback.{hop}").n += 1
+    from ..obs.events import emit as emit_event
+    emit_event("tier_fallback", hop=hop)
 #: tensor frames handed through local pipes (the colocated analogue of
 #: ``transport.tx_frames`` — which local hops must NOT touch, so frame
 #: counters keep meaning "bytes that crossed a wire")

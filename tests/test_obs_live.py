@@ -291,12 +291,12 @@ def test_stage_node_watermarks_split_across_two_subscribers():
     node.obs_register(101)
     node.obs_register(202)
     rx.hi = 9
-    p1, _ = node.obs_snapshot(subscriber=101, include_spans=False)
-    p2, _ = node.obs_snapshot(subscriber=202, include_spans=False)
+    p1, _, _ = node.obs_snapshot(subscriber=101, include_spans=False)
+    p2, _, _ = node.obs_snapshot(subscriber=202, include_spans=False)
     assert p1["queues"]["rx_hi"] == 9
     assert p2["queues"]["rx_hi"] == 9, \
         "the second subscriber lost the burst to the first's reset"
-    p1b, _ = node.obs_snapshot(subscriber=101, include_spans=False)
+    p1b, _, _ = node.obs_snapshot(subscriber=101, include_spans=False)
     assert p1b["queues"]["rx_hi"] == 0
     node.obs_unregister(101)
     node.obs_unregister(202)

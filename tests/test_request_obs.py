@@ -1,0 +1,239 @@
+"""Request-scoped serving observability: trace sampling composed with
+the front door, per-request latency attribution summing to the
+measured wall, SLO attainment + attribution in the stats surface, the
+flight-recorder query path, and the Prometheus exposition of the
+serving counters."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import partition
+from defer_tpu.models import resnet_tiny
+from defer_tpu.obs import REGISTRY, tracer
+from defer_tpu.obs.attrib import attribute_request, attribute_sampled
+from defer_tpu.runtime.node import ChainDispatcher, StageNode
+from defer_tpu.serve import ServeClient, TenantConfig
+from defer_tpu.serve.client import fetch_events, fetch_stats
+from defer_tpu.serve.frontdoor import ChainBackend, ServeFrontDoor
+
+IN_SHAPE = (32, 32, 3)
+
+
+@pytest.fixture(scope="module")
+def traced_door():
+    """A 2-stage delay-bound chain behind a front door with tracing on
+    and every request sampled (trace_sample_every=1) — the vehicle for
+    the attribution/trace assertions.  In-process thread nodes share
+    one tracer, so all spans land on one exact clock."""
+    g = resnet_tiny()
+    params = g.init(jax.random.key(0))
+    stages = partition(g, num_stages=2)
+    tr = tracer()
+    tr.enabled = True
+    tr.process = "serve"
+    tr.start_trace()
+    nodes = [StageNode(None, "127.0.0.1:0", None) for _ in stages]
+    addrs = [f"127.0.0.1:{n.address[1]}" for n in nodes]
+    threads = [threading.Thread(target=n.serve, daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    disp = ChainDispatcher(addrs[0], codec="raw")
+    # decode-side sleep on the stage0->stage1 hop: a real (non-CPU)
+    # transport.hop1 cost the attribution must find
+    disp.deploy(stages, params, addrs, batch=2,
+                codecs=["dsleep5+raw", "raw"])
+    door = ServeFrontDoor(
+        backend=ChainBackend(disp, 2, IN_SHAPE, trace_sample_every=1),
+        tenants=[TenantConfig("obs_gold", deadline_ms=5000.0)]).start()
+    yield door, addrs
+    door.stop()
+    for t in threads:
+        t.join(timeout=30)
+    tr.enabled = False
+    tr.clear()
+
+
+def _stream(door, tenant, n=3, deadline_ms=5000.0):
+    host, port = door.address
+    rng = np.random.default_rng(7)
+    data = [rng.standard_normal(IN_SHAPE).astype(np.float32)
+            for _ in range(n)]
+    outs = ServeClient(host, port, tenant,
+                       deadline_ms=deadline_ms).stream(data)
+    assert all(o is not None and o[0] == "ok" for o in outs), outs
+    return outs
+
+
+def _tenant_requests(spans, tenant):
+    return sorted({int(s["args"]["rid"]) for s in spans
+                   if s["name"] == "serve.request"
+                   and s["args"].get("tenant") == tenant})
+
+
+def test_sampled_request_trace_is_complete_and_ordered(traced_door):
+    """The regression bar: a sampled served request's trace contains
+    admission + gather + EVERY stage + result-edge spans, and their
+    completion points are monotone on the timeline (no negative
+    inter-span gaps)."""
+    door, _ = traced_door
+    _stream(door, "obs_gold", n=3)
+    spans = tracer().spans
+    rids = _tenant_requests(spans, "obs_gold")
+    assert len(rids) == 3, f"every request must be sampled: {rids}"
+    for rid in rids:
+        mine = {s["name"]: s for s in spans
+                if (s["args"] or {}).get("rid") == rid}
+        root = mine["serve.request"]
+        seq = root["args"]["seq"]
+        frame = {s["name"]: s for s in spans
+                 if (s["args"] or {}).get("seq") == seq}
+        chain = [mine["serve.admission_wait"], frame["serve.gather"],
+                 frame["stage0.infer"], frame["stage1.infer"],
+                 mine["serve.deliver"]]
+        # spans are integer-microsecond quantized (ts/dur truncate
+        # independently), so boundaries shared by two spans can
+        # disagree by a few us — that slack, never more
+        slack = 3
+        ends = [s["ts_us"] + s["dur_us"] for s in chain]
+        for a, b in zip(ends, ends[1:]):
+            assert b >= a - slack, (
+                f"rid {rid}: negative inter-span gap in "
+                f"{[s['name'] for s in chain]} -> {ends}")
+        # the whole waterfall sits inside the request's root envelope
+        assert root["ts_us"] <= chain[0]["ts_us"] + slack
+        assert ends[-1] <= root["ts_us"] + root["dur_us"] + slack
+
+
+def test_attribution_buckets_sum_to_measured_wall(traced_door):
+    """Acceptance bar: the folded budget buckets (admission + gather +
+    per-stage + per-hop transport + result edge) sum to within 10% of
+    the request's measured end-to-end latency, and the delay-bound hop
+    dominates where physics says it must."""
+    door, _ = traced_door
+    _stream(door, "obs_attr", n=4)
+    spans = tracer().spans
+    reps = [r for r in attribute_sampled(
+        spans, hop_tiers=["tcp", "tcp", "tcp"])
+        if r.tenant == "obs_attr"]
+    assert len(reps) == 4
+    for rep in reps:
+        assert rep.ok(0.10), rep.to_json()
+        for want in ("admission", "gather", "transport.hop0", "stage0",
+                     "transport.hop1", "stage1", "host_sync",
+                     "transport.result", "result_edge"):
+            assert want in rep.buckets, rep.buckets
+        assert rep.tiers["transport.hop0"] == "tcp"
+        # the dsleep5 decode rides the stage0->stage1 hop: that
+        # transport bucket must carry (at least) the injected 5 ms
+        assert rep.buckets["transport.hop1"] >= 4.0, rep.to_json()
+        assert rep.wall_ms >= 5.0
+    one = attribute_request(spans, reps[0].rid)
+    assert one is not None and one.rid == reps[0].rid
+    assert attribute_request(spans, 10**9) is None
+
+
+def test_stats_carry_slo_attainment_and_attribution(traced_door):
+    """monitor --serve surface: per-tenant SLO attainment next to the
+    queue-delay percentiles, door attribution buckets in the reply."""
+    door, _ = traced_door
+    _stream(door, "obs_slo", n=2, deadline_ms=60_000.0)
+    host, port = door.address
+    doc = fetch_stats(host, port)
+    row = doc["tenants"]["obs_slo"]
+    assert row["slo_attainment"] == 1.0
+    assert row["slo_measured"] == 2
+    buckets = doc["attribution"]["obs_slo"]
+    assert buckets["e2e"]["count"] == 2
+    for k in ("admission", "gather", "chain", "result_edge"):
+        assert buckets[k]["count"] == 2
+    # the buckets tile the e2e wall: p50s sum close to the e2e p50
+    total = sum(buckets[k]["p50"]
+                for k in ("admission", "gather", "chain", "result_edge"))
+    assert total == pytest.approx(buckets["e2e"]["p50"], rel=0.35)
+    assert "events_dropped" in doc
+    # a tenant without a deadline is never scored
+    _stream(door, "obs_noslo", n=1, deadline_ms=None)
+    row2 = fetch_stats(host, port)["tenants"]["obs_noslo"]
+    assert row2["slo_attainment"] is None
+
+
+def test_events_since_queries_node_and_door(traced_door):
+    """The flight-recorder query path: a stage node's control socket
+    answers {"cmd": "events_since"}, and the front door answers the
+    observer twin — both carrying the serving run's structured facts."""
+    from defer_tpu.transport.framed import (K_CTRL, connect_retry,
+                                            recv_expect, send_ctrl,
+                                            send_end)
+    door, addrs = traced_door
+    host, _, port = addrs[0].rpartition(":")
+    s = connect_retry(host, int(port), 30.0)
+    try:
+        send_ctrl(s, {"cmd": "events_since", "cursor": 0})
+        reply = recv_expect(s, K_CTRL)
+        send_end(s)
+    finally:
+        s.close()
+    assert reply["cmd"] == "events_reply"
+    assert isinstance(reply["dropped"], int)  # ring-loss visibility
+    kinds = {e["kind"] for e in reply["events"]}
+    assert "stream_begin" in kinds and "admit" in kinds
+    # in-process chain shares one recorder: stage labels prove the
+    # node-side emission sites fired
+    hops = {e["data"].get("hop") for e in reply["events"]
+            if e["kind"] == "stream_begin"}
+    assert "stage0" in hops and "stage1" in hops
+    dh, dp = door.address
+    rep = fetch_events(dh, dp, cursor=0)
+    assert {e["kind"] for e in rep["events"]} >= {"client_open",
+                                                 "client_close"}
+    # incremental contract: the returned cursor yields nothing new
+    again = fetch_events(dh, dp, cursor=rep["cursor"])
+    assert again["events"] == []
+
+
+def test_monitor_cli_renders_events_and_slo(traced_door, capsys):
+    """monitor --serve --events --json: the line carries the merged
+    event log and the per-tenant SLO/attribution columns."""
+    from defer_tpu import cli
+    door, _ = traced_door
+    host, port = door.address
+    cli.main(["monitor", "--serve", f"{host}:{port}", "--events",
+              "--iterations", "1", "--interval-ms", "50", "--json"])
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    doc = next(json.loads(ln) for ln in lines if '"serve"' in ln)
+    kinds = {e["kind"] for e in doc["events"]}
+    assert "client_open" in kinds
+    assert doc["events_dropped"] == 0
+    assert "slo_attainment" in doc["serve"]["tenants"]["obs_gold"]
+    assert "attribution" in doc["serve"]
+
+
+def test_prom_exposition_carries_serving_metrics(traced_door):
+    """serve --prom-port satellite: the registry exposition the scrape
+    endpoint serves includes the front door's counters and per-tenant
+    histograms (sanitized names, quantile lines)."""
+    door, _ = traced_door
+    text = REGISTRY.exposition()
+    assert "serve_admitted" in text and "serve_shed" in text
+    assert "serve_tenant_obs_gold_admitted" in text
+    assert 'serve_tenant_obs_gold_queue_delay_s{quantile="0.99"}' in text
+    assert "events_dropped" in text
+
+
+def test_trace_compose_still_rejects_fan_restamping():
+    """The one place request-scoped tracing is rejected loudly: a
+    replicated first/last stage re-stamps the wire seq space, so
+    request frames (sampled or not) refuse to ride it."""
+    disp = ChainDispatcher.__new__(ChainDispatcher)
+    disp.result_fan_in = 2
+    disp._send_sock = object()
+    disp._tx_chan = object()
+    with pytest.raises(ValueError, match="non-replicated"):
+        disp.send_request_frame(np.zeros((1, 2)), seq=0)

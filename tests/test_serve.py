@@ -497,6 +497,12 @@ def test_frontdoor_decode_roundtrip_and_disconnect(decode_door):
         assert time.monotonic() < deadline, \
             "the disconnected client's KV slot was never reclaimed"
         time.sleep(0.05)
+    # decode attribution: the engine's residency lands in the CHAIN
+    # bucket (queue wait in admission), not all-in-admission
+    buckets = fetch_stats(host, port)["attribution"]["steady"]
+    assert buckets["e2e"]["count"] == 1
+    assert buckets["chain"]["p50"] > 0
+    assert buckets["chain"]["p50"] > buckets["admission"]["p50"]
     door.healthcheck()
 
 
