@@ -321,6 +321,17 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: memcpys per hop per frame the device-resident path eliminates; the
 #: local tier is reported too but jax CPU host interop is zero-copy
 #: both ways, so ici ~= local on this vehicle by design)
+#: ... and `cost_model_truth` (the cost-model truth loop: calibrate
+#: CalibratedConstants — host-sync / wire bandwidths, per-deployed-
+#: codec throughputs — from a no-delay chain's own telemetry, then
+#: assert the CALIBRATED model predicts the codec-delay-bound chain's
+#: bottleneck stage service within 15% where the default model —
+#: which prices the unknown dsleep/esleep codecs as raw — is
+#: measurably worse; an injected slowdown must fire a `model_drift`
+#: flight-recorder event within 2 monitor intervals; telemetry
+#: overhead stays < 5% on the interleaved min-of-3 protocol; the row
+#: embeds the fitted constants so BENCH_LEDGER.jsonl carries the
+#: calibration trajectory — docs/PLANNER.md "calibrated constants")
 #: ... and `request_attribution` (request-scoped serving
 #: observability: under the serving row's 2x-burst open-loop trace,
 #: the p50 AND p99 sampled requests' attributed budget buckets —
@@ -343,7 +354,39 @@ SCRIPT_ROWS = {
     "serving_frontdoor": "serve_smoke.py",
     "request_attribution": "request_obs_smoke.py",
     "dag_pipeline": "dag_smoke.py",
+    "cost_model_truth": "capacity_smoke.py",
 }
+
+
+def ledger_append(path: str, row: dict):
+    """Append one row to the machine-readable benchmark ledger
+    (JSON-lines, one object per line, append-only — the cross-run
+    trajectory BENCH_*.json snapshots cannot give).  Every row — config
+    results, script rows, AND failures — lands here with a wall-clock
+    stamp, so a probed-down row is an explicit record with a reason
+    field, not a silent omission."""
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    except OSError as e:
+        log(f"ledger: cannot append to {path}: {e!r}")
+
+
+def failure_row(name: str, exc: Exception, *, kind: str,
+                elapsed_s: float) -> dict:
+    """An explicit machine-readable failure row: the metric that did
+    NOT get measured and why.  `reason` carries the exception text
+    (e.g. a smoke script's rc/stderr tail), `row_kind` whether it was
+    a script-delegated probe or an in-process config."""
+    return {
+        "metric": name,
+        "status": "failed",
+        "row_kind": kind,
+        "reason": f"{type(exc).__name__}: {exc}",
+        "elapsed_s": round(elapsed_s, 1),
+    }
 
 
 def main():
@@ -365,9 +408,24 @@ def main():
                     help="reseed the serving row's open-loop arrival "
                          "trace (deterministic Poisson + 2x burst; "
                          "defaults to the smoke's built-in seed)")
+    import os
+    default_ledger = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_LEDGER.jsonl")
+    ap.add_argument("--ledger", default=default_ledger, metavar="FILE",
+                    help="append every row (successes AND explicit "
+                         "failure rows) to this JSON-lines ledger "
+                         "('' disables)")
     args = ap.parse_args()
 
-    chunk = args.chunk or (128 if jax.default_backend() == "tpu" else 16)
+    run_unix = time.time()
+    backend = jax.default_backend()
+
+    def emit(row: dict):
+        row = {**row, "run_unix": round(run_unix, 1), "backend": backend}
+        print(json.dumps(row), flush=True)
+        ledger_append(args.ledger, row)
+
+    chunk = args.chunk or (128 if backend == "tpu" else 16)
     for name in args.configs.split(","):
         name = name.strip()
         if name in SCRIPT_ROWS:
@@ -380,13 +438,19 @@ def main():
                 r = run_script_row(SCRIPT_ROWS[name], extra)
             except Exception as e:  # noqa: BLE001 — keep the suite going
                 log(f"{name}: FAILED {type(e).__name__}: {e}")
+                emit(failure_row(name, e, kind="script",
+                                 elapsed_s=time.time() - t0))
                 continue
             log(f"{name}: {r['value']}x ({r['unit']}, "
                 f"{time.time() - t0:.0f}s)")
-            print(json.dumps(r), flush=True)
+            emit(r)
             continue
         if name not in CONFIGS:
             log(f"unknown config {name!r}; have {list(CONFIGS)}")
+            emit({"metric": name, "status": "failed",
+                  "row_kind": "config",
+                  "reason": f"unknown config; have "
+                            f"{sorted(list(CONFIGS) + list(SCRIPT_ROWS))}"})
             continue
         t0 = time.time()
         try:
@@ -397,9 +461,11 @@ def main():
                            weights_dir=args.weights_dir)
         except Exception as e:  # noqa: BLE001 — keep the suite going
             log(f"{name}: FAILED {type(e).__name__}: {e}")
+            emit(failure_row(name, e, kind="config",
+                             elapsed_s=time.time() - t0))
             continue
         log(f"{name}: {r['value']} inf/s ({time.time() - t0:.0f}s)")
-        print(json.dumps(r), flush=True)
+        emit(r)
 
 
 if __name__ == "__main__":

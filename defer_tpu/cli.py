@@ -122,6 +122,13 @@ def _add_cost_flags(p):
                         "instead of the cheapest wire codec, so cut "
                         "placement exploits same-mesh colocation "
                         "(docs/PLANNER.md)")
+    p.add_argument("--calibrated", default="", metavar="FILE",
+                   help="overlay a CalibratedConstants JSON artifact "
+                        "(chain --emit-calibration / "
+                        "plan.calibrate.fit_from_stats) on the cost "
+                        "model: measured codec throughputs and "
+                        "host-sync/ici/local/wire bandwidths replace "
+                        "the analytic defaults (docs/PLANNER.md)")
 
 
 def _parse_hop_tier_map(spec: str) -> dict | None:
@@ -149,12 +156,17 @@ def _cost_model(args, graph, *, node_costs=None):
         codecs = calibrate_codecs(tuple(names))
     else:
         codecs = {n: DEFAULT_CODECS[n] for n in names}
-    return StageCostModel(graph, batch=getattr(args, "batch", 1),
+    cost = StageCostModel(graph, batch=getattr(args, "batch", 1),
                           link_bw_s=args.link_bw or None,
                           ici_bw_s=getattr(args, "ici_bw", 0.0) or None,
                           codecs=codecs, node_costs=node_costs,
                           hop_tiers=_parse_hop_tier_map(
                               getattr(args, "hop_tier_map", "")))
+    calibrated = getattr(args, "calibrated", "")
+    if calibrated:
+        from .plan import CalibratedConstants
+        cost = CalibratedConstants.load(calibrated).apply(cost)
+    return cost
 
 
 def _partition_json(graph, stages, plan=None) -> dict:
@@ -878,6 +890,29 @@ def cmd_chain(args):
     }
     if n_deployed != len(stages):
         row["stages_requested"] = len(stages)
+    # node-side MFU accounting (obs/capacity.py): only present when a
+    # stage reported an honest figure (known chip peak) — never 0.0
+    # stand-ins on hosts where the peak is unknowable
+    mfu_of = {int(s["stage"]): s["mfu"] for s in stats
+              if s.get("stage") is not None and s.get("mfu") is not None}
+    if mfu_of:
+        row["stage_mfu"] = {f"stage{k}": round(v, 4)
+                            for k, v in sorted(mfu_of.items())}
+    if args.emit_calibration:
+        from .plan.calibrate import CalibrationError, fit_from_stats
+        from .utils import hw
+        try:
+            gen = hw.identify_chip(jax.devices()[0])
+        except Exception:  # noqa: BLE001 — no backend
+            gen = "unknown"
+        try:
+            cal = fit_from_stats(graph,
+                                 [s.output_name for s in stages[:-1]],
+                                 stats, batch=args.batch, gen=gen)
+        except CalibrationError as e:
+            raise SystemExit(f"--emit-calibration: {e}") from e
+        cal.save(args.emit_calibration)
+        row["calibration"] = args.emit_calibration
     if replicas:
         row["replicas"] = {f"stage{k}": r
                            for k, r in sorted(replicas.items())}
@@ -889,14 +924,17 @@ def cmd_chain(args):
     _obs_finish(args)
 
 
-def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
+def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool,
+                    drift=()):
     """One refresh of the top-style monitor table (human mode)."""
     tty = sys.stdout.isatty()
     if clear and tty:
         print("\x1b[2J\x1b[H", end="")
     print(f"{'STAGE':>5} {'BR':>3} {'REP':>3} {'TIER':>5} {'INF/S':>8} "
           f"{'P50MS':>9} "
-          f"{'P95MS':>9} {'P99MS':>9} {'HS50':>7} {'RXQ':>4} {'TXQ':>4} "
+          f"{'P95MS':>9} {'P99MS':>9} {'HS50':>7} {'MFU%':>6} "
+          f"{'PRED':>9} {'MEAS':>9} {'ERR%':>7} "
+          f"{'RXQ':>4} {'TXQ':>4} "
           f"{'RX^':>4} {'TX^':>4} {'INF':>4} {'RX B/S':>11} "
           f"{'TX B/S':>11} {'DONE':>8}  ADDR")
     for r in rows:
@@ -924,10 +962,19 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
         # ici (device-resident) hop's proof mark
         hs = r.get("host_sync_ms") or {}
         hs50 = "-" if not hs.get("count") else f"{hs.get('p50', 0):.3f}"
+        # MFU is "-" unless the node reported an HONEST figure (known
+        # chip peak + deployed capacity) — a fabricated 0.0 would be
+        # indistinguishable from a real idle chip
+        mfu = "-" if r.get("mfu") is None else f"{r['mfu'] * 100:.1f}"
+        # predicted-vs-measured service audit (obs/capacity.py): only
+        # rendered when monitor has --plan and --model to predict from
+        pred = "-" if r.get("pred_ms") is None else f"{r['pred_ms']:.3f}"
+        meas = "-" if r.get("meas_ms") is None else f"{r['meas_ms']:.3f}"
+        errp = "-" if r.get("err") is None else f"{r['err'] * 100:+.1f}"
         line = (f"{stage:>5} {br:>3} {rep:>3} {tier:>5} "
                 f"{r['throughput_per_s']:>8.1f} "
                 f"{p['p50']:>9.3f} {p['p95']:>9.3f} {p['p99']:>9.3f} "
-                f"{hs50:>7} "
+                f"{hs50:>7} {mfu:>6} {pred:>9} {meas:>9} {errp:>7} "
                 f"{r['rx_q']:>4.0f} {r['tx_q']:>4.0f} "
                 f"{r['rx_hi']:>4.0f} {r['tx_hi']:>4.0f} "
                 f"{r['inflight']:>4.0f} {r['rx_bytes_per_s']:>11.0f} "
@@ -944,6 +991,11 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool):
         print(f"straggler: stage {f.stage} [{f.reason}] measured "
               f"{f.measured_ms:.3f} ms vs planned {f.expected_ms:.3f} ms "
               f"(x{f.ratio:.2f}, {f.intervals} intervals)")
+    for f in drift:
+        print(f"model_drift: stage {f.stage} predicted "
+              f"{f.predicted_ms:.3f} ms vs measured "
+              f"{f.measured_ms:.3f} ms ({f.rel_err * 100:+.1f}%, "
+              f"{f.intervals} intervals)")
     if offsets:
         worst = max(abs(v["offset_us"]) for v in offsets.values())
         print(f"clock: {len(offsets)} nodes aligned "
@@ -1190,7 +1242,7 @@ def cmd_monitor(args):
     if not addrs and not args.serve:
         raise SystemExit("monitor requires --nodes host:port[,...] "
                          "and/or --serve host:port")
-    detector = plan = graph = None
+    detector = plan = graph = auditor = None
     if args.plan:
         from .plan import plan_from_json
         with open(args.plan) as f:
@@ -1200,6 +1252,27 @@ def cmd_monitor(args):
                                      sustain=args.sustain)
         if args.model:
             graph = _get_model(args.model)
+            # drift auditor (obs/capacity.py): per-stage service
+            # predictions ALIGNED with what the view measures (max of
+            # compute / inbound decode / outbound encode, codec-only —
+            # plan.calibrate.predict_stage_service_s), scored against
+            # the window-bounded live estimates every interval.  The
+            # cost model is the plan's own (calibrated constants
+            # round-trip through plan JSON); --calibrated overlays a
+            # newer artifact
+            from .obs.capacity import DriftAuditor
+            from .plan.calibrate import predict_stage_service_s
+            from .plan.replan import cost_model_from_plan
+            cost = cost_model_from_plan(graph, plan)
+            if getattr(args, "calibrated", ""):
+                from .plan import CalibratedConstants
+                cost = CalibratedConstants.load(
+                    args.calibrated).apply(cost)
+            pred_ms = [s * 1e3 for s in predict_stage_service_s(
+                graph, plan.cuts, plan.codecs, cost)]
+            auditor = DriftAuditor(pred_ms,
+                                   threshold=args.drift_threshold,
+                                   sustain=args.sustain)
     view = ClusterView()
     if addrs:
         view.connect(addrs, interval_ms=args.interval_ms,
@@ -1248,6 +1321,13 @@ def cmd_monitor(args):
             rows = view.rows()
             bott = view.bottleneck()
             flags = detector.observe(view) if detector is not None else []
+            drift_flags = []
+            if auditor is not None:
+                drift_flags = auditor.observe(view)
+                for r in rows:
+                    audit = auditor.last.get(r.get("stage"))
+                    if audit:
+                        r.update(audit)
             suggestion = err = None
             if flags and graph is not None:
                 try:
@@ -1257,6 +1337,7 @@ def cmd_monitor(args):
             if args.json:
                 doc = {"iteration": i, "bottleneck": bott, "rows": rows,
                        "stragglers": [f.to_json() for f in flags],
+                       "drift": [f.to_json() for f in drift_flags],
                        "clock_offsets": {
                            a: round(v["offset_us"], 1)
                            for a, v in view.clock_offsets.items()}}
@@ -1274,7 +1355,7 @@ def cmd_monitor(args):
                 print(json.dumps(doc), flush=True)
             else:
                 _render_monitor(rows, bott, flags, view.clock_offsets,
-                                clear=i > 1)
+                                clear=i > 1, drift=drift_flags)
                 if events:
                     for ev in events[-16:]:
                         data = " ".join(f"{k}={v}" for k, v in
@@ -1640,6 +1721,13 @@ def main(argv=None):
     c.add_argument("--topology", default=None, metavar="FILE",
                    help="deploy an explicit topology JSON (a `plan "
                         "--dag --json` document) instead of solving")
+    c.add_argument("--emit-calibration", default="", metavar="FILE",
+                   help="after the run, fit CalibratedConstants "
+                        "(host_sync/ici/wire bandwidths, per-deployed-"
+                        "codec throughputs) from the chain's own "
+                        "telemetry and write the versioned JSON "
+                        "artifact — feed it back via `plan "
+                        "--calibrated` (docs/PLANNER.md)")
     _add_overlap_flags(c)
     _add_obs_flags(c)
 
@@ -1760,6 +1848,17 @@ def main(argv=None):
     mo.add_argument("--sustain", type=int, default=2,
                     help="reporting intervals a deviation must hold "
                          "before it is flagged")
+    mo.add_argument("--calibrated", default="", metavar="FILE",
+                    help="with --plan and --model: overlay a "
+                         "CalibratedConstants artifact (`chain "
+                         "--emit-calibration`) on the plan's cost "
+                         "model before computing the drift auditor's "
+                         "per-stage predictions")
+    mo.add_argument("--drift-threshold", type=float, default=0.25,
+                    help="with --plan and --model: |measured - "
+                         "predicted| / predicted past this, sustained "
+                         "--sustain intervals, flags the stage and "
+                         "emits a model_drift event")
     mo.add_argument("--serve", default="", metavar="host:port",
                     help="also poll a serve front door's stats endpoint "
                          "and render per-tenant columns (admitted / "

@@ -30,6 +30,8 @@ from .attrib import (DoorAttribution, RequestAttribution,
 from .cluster import (ClusterView, StragglerDetector, StragglerFlag,
                       align_clock, estimate_clock_offset,
                       expected_stage_ms)
+from .capacity import (CapacityModel, DriftAuditor, DriftFlag,
+                       achieved_mfu, stage_flops_bytes)
 from .report import ObsReporter, start_prom_server
 
 __all__ = [
@@ -43,5 +45,7 @@ __all__ = [
     "DoorAttribution",
     "ClusterView", "StragglerDetector", "StragglerFlag",
     "estimate_clock_offset", "align_clock", "expected_stage_ms",
+    "CapacityModel", "DriftAuditor", "DriftFlag", "achieved_mfu",
+    "stage_flops_bytes",
     "ObsReporter", "start_prom_server",
 ]

@@ -45,6 +45,11 @@ from .trace import Tracer, tracer
 #: a queue watermark at >= this fraction of its depth counts as saturated
 SATURATION_FRAC = 0.9
 
+#: default window (in pushes) for the rolling service estimates — ~2 s
+#: at the default 250 ms report interval.  Shared with the capacity
+#: plane's drift auditor (obs/capacity.py imports it from here).
+SERVICE_WINDOW = 8
+
 
 # ---------------------------------------------------------------------------
 # clock alignment
@@ -126,6 +131,22 @@ def _service_ms(push: dict) -> float:
     return max(_p50_ms(lat.get("infer_s")),
                _p50_ms(lat.get("decode_s")),
                _p50_ms(lat.get("encode_s")))
+
+
+def _win_mean_ms(history, phase: str) -> float | None:
+    """Delta-mean (ms) of one latency phase over a push window: the
+    exact ``sum``/``count`` fields of the first and last push in the
+    window subtract cleanly (percentiles do not), so the estimate
+    reflects ONLY the frames of the current window — a regime shift
+    shows up within a few pushes instead of being averaged into the
+    lifetime fold.  ``None`` when the phase gained no samples."""
+    first = (history[0][1].get("latency") or {}).get(phase) or {}
+    last = (history[-1][1].get("latency") or {}).get(phase) or {}
+    n = int(last.get("count", 0)) - int(first.get("count", 0))
+    if n <= 0:
+        return None
+    return (float(last.get("sum", 0.0))
+            - float(first.get("sum", 0.0))) / n * 1e3
 
 
 class _Node:
@@ -363,6 +384,19 @@ class ClusterView:
                     "count": int((lat.get("host_sync_s") or {})
                                  .get("count", 0))},
                 "service_ms": round(_service_ms(last), 4),
+                # window-bounded rolling service (delta-means over the
+                # last few pushes) — the current-regime estimate the
+                # drift auditor and suggest() score against
+                "service_win_ms": round(
+                    self._windowed_service_ms(node, SERVICE_WINDOW), 4),
+                # capacity accounting shipped by the node itself
+                # (deploy message carries the stage's analytic FLOPs;
+                # the node owns its chip generation).  mfu is None —
+                # rendered "-" — when the peak is unknown.
+                "flops": (last.get("capacity") or {}).get("flops"),
+                "mfu": (last.get("capacity") or {}).get("mfu"),
+                "achieved_flops_s": (last.get("capacity") or {})
+                .get("achieved_flops_s"),
                 "rx_q": q.get("rx", 0), "tx_q": q.get("tx", 0),
                 "rx_hi": peak("rx_hi"), "tx_hi": peak("tx_hi"),
                 "rx_depth": q.get("rx_depth", 0),
@@ -497,13 +531,49 @@ class ClusterView:
         # between near-equal stages refresh to refresh
         return None
 
-    def stage_service_ms(self) -> dict[int, float]:
+    def _windowed_service_ms(self, node: _Node, window: int) -> float:
+        """One node's window-bounded service estimate: the max of the
+        three phase delta-means (see :func:`_win_mean_ms`) over the last
+        ``window`` pushes.  Falls back to the lifetime p50 estimate
+        when the window holds fewer than two pushes or no phase gained
+        samples (an idle chain keeps its last honest figure instead of
+        reading as infinitely fast)."""
+        h = list(node.history)[-max(2, int(window)):]
+        if not h:
+            return 0.0
+        if len(h) < 2:
+            return _service_ms(h[-1][1])
+        vals = [v for v in (_win_mean_ms(h, ph) for ph in
+                            ("infer_s", "decode_s", "encode_s"))
+                if v is not None]
+        if not vals:
+            return _service_ms(h[-1][1])
+        return max(vals)
+
+    def stage_service_ms(self, *, window: int | None = None
+                         ) -> dict[int, float]:
         """Live UNDIVIDED per-stage service estimate (ms): the mean
         replica service time — what one replica costs per frame, the
         unit :func:`defer_tpu.plan.replan.measured_stage_seconds`
-        expects (the solver divides by R itself)."""
-        return {k: sum(r["service_ms"] for r in reps) / len(reps)
-                for k, reps in self._stage_map().items()}
+        expects (the solver divides by R itself).
+
+        ``window`` bounds the estimate to the last N pushes (rolling
+        delta-means) instead of the lifetime histogram fold — the form
+        calibration and drift scoring use, so a long-running chain's
+        current regime is scored rather than its cold-start average."""
+        if window is None:
+            return {k: sum(r["service_ms"] for r in reps) / len(reps)
+                    for k, reps in self._stage_map().items()}
+        with self._lock:
+            nodes = list(self._nodes.values())
+        acc: dict[int, list[float]] = {}
+        for node in nodes:
+            stage = node.ident.get("stage")
+            if stage is None or not node.history:
+                continue
+            acc.setdefault(int(stage), []).append(
+                self._windowed_service_ms(node, window))
+        return {k: sum(vs) / len(vs) for k, vs in acc.items()}
 
     def stage_effective_ms(self) -> dict[int, float]:
         """Live per-stage effective service estimate (ms): the mean
@@ -683,9 +753,14 @@ class StragglerDetector:
             cost = cost_model_from_plan(graph, plan)
         # drop stages with no samples yet (a wedged-from-boot stage has
         # 0.0 service): a zero would scale that stage's cost to nothing
-        # and the re-solve would pile work onto the dead stage
-        measured = {k: v / 1e3
-                    for k, v in view.stage_service_ms().items() if v > 0}
+        # and the re-solve would pile work onto the dead stage.
+        # Window-bounded on purpose: the suggestion must correct toward
+        # the CURRENT regime, not the lifetime average with cold-start
+        # samples folded in forever
+        measured = {
+            k: v / 1e3
+            for k, v in view.stage_service_ms(
+                window=SERVICE_WINDOW).items() if v > 0}
         result = replan(graph, plan, measured, cost)
         emit_event("replan", moved=bool(result.moved),
                    corrections={str(k): round(float(v), 4)

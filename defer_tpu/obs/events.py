@@ -57,6 +57,8 @@ EVENT_KINDS = {
     "client_close": "a tenant connection finished or died (tenant)",
     "decode_join": "a decode request claimed an engine slot (rid)",
     "decode_cancel": "a decode request's slot was reclaimed (rid)",
+    "model_drift": "a stage's measured service drifted from the cost "
+                   "model's prediction (stage, rel_err)",
 }
 
 #: the wire schema's required keys (and the only keys)

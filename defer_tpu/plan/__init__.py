@@ -20,6 +20,10 @@ the real objective:
 See ``docs/PLANNER.md`` for the model and the recurrence.
 """
 
+from .calibrate import (CalibratedConstants, CalibrationError,
+                        fit_constants, fit_from_stats,
+                        hop_telemetry_from_stats, measure_memory_bw,
+                        predict_stage_service_s)
 from .cost import (CodecSpec, DEFAULT_CODECS, TIER_CODECS, StageCostModel,
                    bench_codec_instance, bench_codec_spec,
                    calibrate_codecs, max_batch_within_budget,
@@ -44,4 +48,7 @@ __all__ = [
     "ReplanResult", "replan", "measured_stage_seconds",
     "corrected_cost_model", "cost_model_from_plan",
     "max_batch_within_budget", "stage_ms_at_batch",
+    "CalibratedConstants", "CalibrationError", "fit_constants",
+    "fit_from_stats", "hop_telemetry_from_stats", "measure_memory_bw",
+    "predict_stage_service_s",
 ]

@@ -419,6 +419,24 @@ class StageCostModel:
         h = self.host_sync_seconds(cut) / 2
         return enc + h, wire, dec + h
 
+    def comm_parts_deployed(self, cut: str, codec: str
+                            ) -> tuple[float, float, float]:
+        """:meth:`comm_parts` for a DEPLOYED codec name: a wire codec
+        the table has no row for is priced as ``raw`` instead of
+        raising.  This is the audit/rescoring path (``evaluate_cuts``'s
+        ``hop_codecs`` pin): a deployment can run codecs the analytic
+        table never heard of, and scoring what actually runs must not
+        crash — the raw fallback IS the uncalibrated model's documented
+        failure mode, which calibration (fitted specs keyed by the
+        deployed name) removes."""
+        if codec in TIER_CODECS or codec in self.codecs:
+            return self.comm_parts(cut, codec)
+        spec = self.codecs.get("raw") or next(iter(self.codecs.values()))
+        enc, wire, dec = spec.comm_parts(self.cut_bytes(cut),
+                                         self.link_bw_s)
+        h = self.host_sync_seconds(cut) / 2
+        return enc + h, wire, dec + h
+
     def best_codec_replicated(self, cut: str, r_up: int, r_down: int
                               ) -> tuple[str, float]:
         """Cheapest (codec, effective seconds) for the hop at ``cut``
@@ -474,11 +492,15 @@ class StageCostModel:
             "node_costs": "measured" if self.node_costs else "roofline",
             "codecs": {n: dataclasses.asdict(c)
                        for n, c in self.codecs.items()},
+            # the tier bandwidths travel unconditionally (not only when
+            # hop_tiers is set): a CALIBRATED model's constants must
+            # survive the plan-JSON roundtrip even when the plan it
+            # seeds later declares tiers the original model never had
+            "local_bw_s": self.local_bw_s,
+            "ici_bw_s": self.ici_bw_s,
         }
         if self.hop_tiers:
             d["hop_tiers"] = dict(sorted(self.hop_tiers.items()))
-            d["local_bw_s"] = self.local_bw_s
-            d["ici_bw_s"] = self.ici_bw_s
         return d
 
 

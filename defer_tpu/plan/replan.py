@@ -28,15 +28,32 @@ import dataclasses
 import re
 
 from ..graph.ir import LayerGraph
-from .cost import StageCostModel
+from .cost import CodecSpec, StageCostModel
 from .solver import (Plan, ReplicatedPlan, evaluate_cuts, solve,
                      solve_replicated)
 
 _STAGE_KEY = re.compile(r"(?:^|\.)stage(\d+)\.latency_s$")
 
 
+def _window_mean(now, base) -> float | None:
+    """Delta-mean of a cumulative summary against a baseline snapshot:
+    ``(sum - sum0) / (count - count0)``.  Percentiles cannot be
+    subtracted; the exact sum/count fields can — the window-bounded
+    form that scores the CURRENT regime instead of the lifetime fold
+    (a serve chain's cold-start/compile samples otherwise skew the
+    average forever)."""
+    if not isinstance(base, dict) or not base.get("count"):
+        return None
+    n = int(now.get("count", 0)) - int(base.get("count", 0))
+    if n <= 0:
+        return None
+    return (float(now.get("sum", 0.0))
+            - float(base.get("sum", 0.0))) / n
+
+
 def measured_stage_seconds(source, *, quantile: str = "p50",
-                           scale: float = 1.0) -> dict[int, float]:
+                           scale: float = 1.0,
+                           baseline=None) -> dict[int, float]:
     """stage index -> measured seconds, from telemetry.
 
     ``source`` is a registry snapshot dict (histogram summaries under
@@ -50,17 +67,38 @@ def measured_stage_seconds(source, *, quantile: str = "p50",
     steady-state number; mean is skewed by compile outliers).  ``scale``
     converts units if the source was exported scaled.
 
+    ``baseline`` is an EARLIER snapshot of the same shape: when given,
+    each summary is reduced to its window-bounded delta-mean against
+    the matching baseline summary (see :func:`_window_mean`) — the form
+    replan/calibration use on long-running chains, where the lifetime
+    histograms average cold-start samples in forever.  Summaries with
+    no baseline match (or no new samples) keep the lifetime figure.
+
     Replicated stages report one ``stats`` row per replica; their
     per-frame service times are averaged into one per-stage figure (a
     replica's latency measures the UNDIVIDED stage cost — the division
     by R happens in the solver's objective, not in telemetry).
     """
     acc: dict[int, list[float]] = {}
+    base_map: dict = {}
+    if isinstance(baseline, dict):
+        for key, summ in baseline.items():
+            m = _STAGE_KEY.search(key)
+            if m:
+                base_map[int(m.group(1))] = summ
+    elif baseline is not None:
+        for row in baseline:
+            if isinstance(row, dict) and row.get("stage") is not None:
+                base_map[(int(row["stage"]), row.get("replica"))] = \
+                    row.get("infer_latency_s")
 
-    def take(stage: int, summ) -> None:
+    def take(stage: int, summ, base_key=None) -> None:
         if not isinstance(summ, dict) or not summ.get("count"):
             return
-        v = summ.get(quantile, summ.get("mean"))
+        win = _window_mean(summ, base_map.get(base_key)) \
+            if base_key is not None else None
+        v = win if win is not None else summ.get(quantile,
+                                                 summ.get("mean"))
         if v is not None:
             acc.setdefault(int(stage), []).append(float(v) * scale)
 
@@ -77,11 +115,12 @@ def measured_stage_seconds(source, *, quantile: str = "p50",
         for key, summ in source.items():
             m = _STAGE_KEY.search(key)
             if m:
-                take(int(m.group(1)), summ)
+                take(int(m.group(1)), summ, base_key=int(m.group(1)))
     else:  # ChainDispatcher.stats reply list (one row per replica)
         for row in source:
             if isinstance(row, dict) and row.get("stage") is not None:
-                take(row["stage"], row.get("infer_latency_s"))
+                take(row["stage"], row.get("infer_latency_s"),
+                     base_key=(int(row["stage"]), row.get("replica")))
     return {k: sum(vs) / len(vs) for k, vs in acc.items()}
 
 
@@ -151,8 +190,20 @@ def cost_model_from_plan(graph: LayerGraph, plan: Plan) -> StageCostModel:
     tiers = {c: t for c, t in zip(plan.cuts,
                                   getattr(plan, "hop_tiers", None) or [])
              if t != "tcp"}
+    # a CALIBRATED model's codec table (fitted throughputs, possibly
+    # codec names the analytic defaults never heard of) travels in the
+    # plan's cost_model dict too — restore it, or a replan seeded from
+    # a calibrated plan silently reverts to guessed codec constants
+    codec_doc = (plan.cost or {}).get("codecs")
+    codecs = {n: CodecSpec(**c) for n, c in codec_doc.items()} \
+        if codec_doc else None
     return StageCostModel(
         graph, node_costs=node_costs, hop_tiers=tiers or None,
+        codecs=codecs,
+        # comm terms scale with the frame batch (cut_bytes): restore
+        # the plan's, or a batch-N plan's hops re-price at batch 1
+        batch=int((plan.cost or {}).get("batch") or 1),
+        link_bw_s=(plan.cost or {}).get("link_bw_s"),
         # the tier map's bandwidth half travels in the plan's cost_model
         # dict — without it a calibrated local_bw_s would silently reset
         # to the default in replans seeded from plan JSON (likewise the

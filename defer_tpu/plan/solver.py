@@ -138,7 +138,8 @@ def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
                   cost: StageCostModel, *,
                   objective: str = "explicit",
                   replicas: list[int] | None = None,
-                  hop_tiers: dict[str, str] | None = None) -> Plan:
+                  hop_tiers: dict[str, str] | None = None,
+                  hop_codecs: list[str] | None = None) -> Plan:
     """Predictions for an *explicit* cut list under ``cost`` (cheapest
     codec per hop) — how quantile or hand-picked cuts score on the same
     model the solver optimizes.  ``replicas`` (one count per stage)
@@ -146,7 +147,12 @@ def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
     by its count and each hop's codec is re-chosen for the fan-adjusted
     ``enc/r_up + wire + dec/r_down`` cost.  ``hop_tiers`` (cut ->
     tcp|local|device) scores colocated hops on their tier pseudo-codec
-    (:meth:`StageCostModel.with_hop_tiers`)."""
+    (:meth:`StageCostModel.with_hop_tiers`).
+
+    ``hop_codecs`` (one per cut) PINS each hop to a codec instead of
+    the argmin — how an audit rescoring a DEPLOYED plan prices the
+    codecs that actually run; names the model has no row for fall back
+    to ``raw`` (:meth:`StageCostModel.comm_parts_deployed`)."""
     if hop_tiers is not None:
         cost = cost.with_hop_tiers(hop_tiers)
     cuts, cum, total, comm = _tables(graph, cost)
@@ -155,6 +161,18 @@ def evaluate_cuts(graph: LayerGraph, cut_points: list[str],
     if missing:
         raise ValueError(f"not valid cut points: {missing}")
     chosen = [pos[c] for c in cut_points]
+    if hop_codecs is not None:
+        if len(hop_codecs) != len(cut_points):
+            raise ValueError(f"{len(cut_points)} cuts but "
+                             f"{len(hop_codecs)} hop codecs")
+        if replicas is not None:
+            raise ValueError("hop_codecs pin is not supported together "
+                             "with replicas (replicated hops re-choose "
+                             "their codec for the fan shape)")
+        comm = list(comm)
+        for i, codec in zip(chosen, hop_codecs):
+            comm[i] = (sum(cost.comm_parts_deployed(cuts[i], codec)),
+                       codec)
     if replicas is None:
         return _mk_plan(graph, cost, chosen, cuts, cum, total, comm,
                         objective)

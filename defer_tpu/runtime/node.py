@@ -173,6 +173,17 @@ class StageNode:
     #: per-subscriber watermark splitter (class default covers
     #: ``__new__``-built stubs; created lazily under ``_WM_LOCK``)
     _wm_split: WatermarkSplit | None = None
+    #: analytic capacity of the deployed stage, shipped by the
+    #: dispatcher in the deploy message (``flops`` / ``bytes_moved`` at
+    #: the deploy batch) — what stats/obs_push MFU accounting divides
+    #: by.  None until a deploy carries them (a standalone node without
+    #: a dispatcher reports no MFU rather than a fabricated one).
+    stage_flops: float | None = None
+    stage_bytes_moved: float | None = None
+    #: cached chip peak (bytes are cheap; the jax probe is not).
+    #: 0.0 = probed and unknown (MFU stays None — utils/hw.py policy:
+    #: never fabricate MFU against a guessed peak); None = not probed.
+    _peak_flops_s: float | None = None
 
     def __init__(self, artifact: str | None, listen: str,
                  next_hop: str | None, *, codec: str = "raw",
@@ -506,6 +517,13 @@ class StageNode:
             if msg.get("infer_delay_ms") is not None:
                 self.infer_delay_s = max(
                     0.0, float(msg["infer_delay_ms"]) / 1e3)
+            # analytic capacity of this stage (dispatcher-computed
+            # FLOPs/HBM bytes at the deploy batch): the denominator of
+            # the node's live MFU accounting (obs/capacity.py)
+            if msg.get("flops") is not None:
+                self.stage_flops = float(msg["flops"])
+            if msg.get("bytes_moved") is not None:
+                self.stage_bytes_moved = float(msg["bytes_moved"])
             if msg.get("tier"):
                 # outbound transport-tier policy rides the deploy
                 # handshake, like the hop codec
@@ -598,6 +616,7 @@ class StageNode:
             m = self.manifest
             reg = REGISTRY
             tx_live = self._live_tx
+            cap = self._capacity()
             send_ctrl(conn, {
                 "stage": None if m is None else m["index"],
                 "name": None if m is None else m["name"],
@@ -672,11 +691,51 @@ class StageNode:
                 "rx_watermark": self._chan_hi(self._live_rx),
                 "tx_watermark": self._chan_hi(self._live_tx),
                 "inflight": reg.gauge("node.inflight").value,
+                # capacity accounting (obs/capacity.py): analytic stage
+                # FLOPs from the deploy message, achieved FLOP/s over
+                # the measured infer p50, and MFU against THIS chip's
+                # peak (None when the deploy shipped no capacity or the
+                # generation has no public peak)
+                "flops": self.stage_flops,
+                "mfu": cap.get("mfu"),
+                "achieved_flops_s": cap.get("achieved_flops_s"),
             })
             return True
         raise ValueError(f"unknown control command {msg!r}")
 
     # -- live observability (obs_push payloads) -----------------------------
+
+    def _capacity(self) -> dict:
+        """Live MFU accounting for stats/obs_push: the deploy message's
+        analytic stage FLOPs against this node's own measured infer p50
+        and ITS OWN chip peak.  Empty when no deploy shipped capacity;
+        ``mfu`` is None — never a number — when the chip generation has
+        no public peak (utils/hw.py: callers must not fabricate MFU
+        against a guessed peak)."""
+        if self.stage_flops is None:
+            return {}
+        if self._peak_flops_s is None:
+            from ..utils import hw
+            gen = "unknown"
+            try:
+                import jax
+                gen = hw.identify_chip(jax.devices()[0])
+            except Exception:  # noqa: BLE001 — no backend: no peak
+                pass
+            self._peak_flops_s = hw.peak_flops(gen)
+        hist = self.infer_hist
+        p50 = hist.quantile(0.5) if hist is not None and hist.count \
+            else 0.0
+        from ..obs.capacity import achieved_mfu
+        mfu = achieved_mfu(self.stage_flops, p50,
+                           self._peak_flops_s or 0.0)
+        return {
+            "flops": self.stage_flops,
+            "bytes_moved": self.stage_bytes_moved,
+            "achieved_flops_s": (self.stage_flops / p50
+                                 if p50 > 0 else None),
+            "mfu": mfu,
+        }
 
     @staticmethod
     def _chan_hi(chan) -> int:
@@ -778,6 +837,10 @@ class StageNode:
                              else reg.histogram(
                                  "codec.decode_s").summary()),
             },
+            # live MFU accounting (obs/capacity.py): {} until a deploy
+            # ships the stage's analytic FLOPs; mfu None without an
+            # honest chip peak
+            "capacity": self._capacity(),
         }
         tr = tracer()
         trace_doc: dict = {"dropped": tr.dropped}
@@ -1862,6 +1925,22 @@ class ChainDispatcher:
                       span_id=root_span)
         return outs
 
+    @staticmethod
+    def _stage_capacity(stage, batch: int) -> dict:
+        """The deploy message's capacity fields: the stage's analytic
+        FLOPs and HBM bytes at the deploy ``batch``
+        (:func:`defer_tpu.obs.capacity.stage_flops_bytes`) — the node
+        can then report live MFU against its own chip peak without ever
+        seeing the graph.  Empty for stage objects that don't carry
+        their graph slice (hand-built test stubs)."""
+        graph = getattr(stage, "graph", None)
+        names = getattr(stage, "node_names", None)
+        if graph is None or not names:
+            return {}
+        from ..obs.capacity import stage_flops_bytes
+        flops, moved = stage_flops_bytes(graph, names, batch=batch)
+        return {"flops": flops, "bytes_moved": moved}
+
     def deploy(self, stages, params, node_addrs: Sequence, *,
                batch: int = 1, result_hop: str | None = None,
                codecs: Sequence[str] | None = None,
@@ -1918,9 +1997,11 @@ class ChainDispatcher:
             nxt = ",".join(groups[i + 1]) if i + 1 < len(groups) \
                 else result_hop
             blob = export_stage_bytes(stage, params, batch=batch)
+            capacity = self._stage_capacity(stage, batch)
             for j, addr in enumerate(addrs):
                 msg = {"cmd": "deploy", "next": nxt,
-                       "codec": codecs[i] if codecs else self.codec}
+                       "codec": codecs[i] if codecs else self.codec,
+                       **capacity}
                 if tiers:
                     msg["tier"] = tiers[i]
                 if devices and devices[i] is not None:
@@ -1973,7 +2054,8 @@ class ChainDispatcher:
             nxt = ",".join(addrs[n] for n in v.next) if v.next \
                 else result_hop
             msg = {"cmd": "deploy", "next": nxt,
-                   "codec": v.codec or self.codec}
+                   "codec": v.codec or self.codec,
+                   **self._stage_capacity(stage, batch)}
             if v.fan == "broadcast":
                 msg["fan"] = "broadcast"
             if v.join >= 2:

@@ -1,0 +1,323 @@
+"""Calibration tests: fits recover known constants from synthetic
+telemetry, the artifact survives plan-JSON roundtrips, and degenerate
+fits are rejected loudly."""
+
+import json
+
+import pytest
+
+from defer_tpu import GraphBuilder
+from defer_tpu.graph import ops
+from defer_tpu.plan import (CalibratedConstants, CalibrationError,
+                            CodecSpec, StageCostModel, evaluate_cuts,
+                            fit_constants, hop_telemetry_from_stats,
+                            plan_from_json, predict_stage_service_s)
+from defer_tpu.plan.calibrate import SCHEMA, codec_only_parts
+from defer_tpu.plan.replan import cost_model_from_plan
+
+
+def dense_chain(widths, name="chain", in_width=8):
+    b = GraphBuilder(name)
+    x = b.input((in_width,))
+    for i, w in enumerate(widths):
+        x = b.add(ops.Dense(w), x, name=f"fc{i}")
+    return b.build()
+
+
+def summ(count, total):
+    """A cumulative histogram summary as stats replies carry it."""
+    return {"count": count, "sum": total, "p50": total / max(count, 1),
+            "mean": total / max(count, 1)}
+
+
+def hop(raw, codec, tier="tcp", *, n=32, enc_bw=None, dec_bw=None,
+        hs_bw=None, link_bw=None, ratio=1.0, tx_s=None, cut="c0",
+        stage=0):
+    """One synthetic per-hop telemetry record generated from KNOWN
+    constants — what the fit must recover.  ``ratio`` is the codec's
+    wire-byte ratio (the link fit regresses over raw/ratio bytes)."""
+    rec = {"cut": cut, "stage": stage, "raw_bytes": raw, "codec": codec,
+           "tier": tier, "enc_s": {"count": 0}, "dec_s": {"count": 0},
+           "host_sync_s": {"count": 0}, "tx_s": {"count": 0}}
+    if enc_bw:
+        rec["enc_s"] = summ(n, n * raw / enc_bw)
+    if dec_bw:
+        rec["dec_s"] = summ(n, n * raw / dec_bw)
+    if hs_bw:
+        rec["host_sync_s"] = summ(n, n * raw / hs_bw)
+    if tx_s is not None:
+        rec["tx_s"] = summ(n, tx_s)
+    elif link_bw:
+        # tx prices encode + send; the wire moves raw/ratio bytes
+        enc_sum = rec["enc_s"].get("sum", 0.0)
+        rec["tx_s"] = summ(n, enc_sum + n * (raw / ratio) / link_bw)
+    return rec
+
+
+# -- fitting -----------------------------------------------------------------
+
+
+def test_fit_recovers_known_constants():
+    raw = 1 << 20
+    hops = [
+        hop(raw, "lzb", enc_bw=2e9, dec_bw=1e9, hs_bw=5e9, link_bw=1e8,
+            ratio=1.3, cut="c0", stage=0),
+        hop(raw // 2, "lzb", enc_bw=2e9, dec_bw=1e9, hs_bw=5e9,
+            link_bw=1e8, ratio=1.3, cut="c1", stage=1),
+    ]
+    cal = fit_constants(hops, gen="v5e", bench_memory=False)
+    spec = cal.codecs["lzb"]
+    assert spec.encode_bytes_per_s == pytest.approx(2e9, rel=1e-6)
+    assert spec.decode_bytes_per_s == pytest.approx(1e9, rel=1e-6)
+    assert cal.host_sync_bw_s == pytest.approx(5e9, rel=1e-6)
+    assert cal.link_bw_s == pytest.approx(1e8, rel=1e-6)
+    assert cal.gen == "v5e"
+    assert cal.provenance["codec.lzb"]["method"] == "measured"
+    assert cal.provenance["codec.lzb"]["samples"] == 128  # enc+dec, 2 hops
+    # lzb is a known name: ratio carried from the default table
+    from defer_tpu.plan import DEFAULT_CODECS
+    assert spec.ratio == DEFAULT_CODECS["lzb"].ratio
+
+
+def test_fit_recovers_ici_bandwidth():
+    raw = 1 << 22
+    want = 3.2e10
+    hops = [hop(raw, "ici", tier="ici", tx_s=32 * raw / want)]
+    cal = fit_constants(hops, bench_memory=False)
+    assert cal.ici_bw_s == pytest.approx(want, rel=1e-6)
+    assert cal.provenance["ici_bw_s"]["method"] == "measured"
+
+
+def test_fit_keys_specs_by_deployed_name():
+    """A codec name the analytic table never heard of (the dsleep/esleep
+    delay vehicles) still calibrates — as a flat throughput spec under
+    its deployed name."""
+    raw = 1 << 20
+    cal = fit_constants([hop(raw, "dsleep10+raw", enc_bw=4e9,
+                             dec_bw=raw / 10e-3)], bench_memory=False)
+    assert "dsleep10+raw" in cal.codecs
+    assert cal.codecs["dsleep10+raw"].decode_bytes_per_s == pytest.approx(
+        raw / 10e-3, rel=1e-6)
+    assert not cal.codecs["dsleep10+raw"].lossy
+
+
+def test_fit_keeps_prior_when_no_telemetry():
+    prior = StageCostModel(dense_chain([8, 8]), gen="v4",
+                           link_bw_s=7e8, ici_bw_s=9e9,
+                           host_sync_bw_s=3e9)
+    # a tcp hop with encode-only telemetry: no host_sync/tx samples
+    cal = fit_constants([hop(1 << 20, "raw", enc_bw=1e9)],
+                        prior=prior, bench_memory=False)
+    assert cal.host_sync_bw_s == prior.host_sync_bw_s
+    assert cal.ici_bw_s == prior.ici_bw_s
+    assert cal.provenance["host_sync_bw_s"]["method"] == "prior"
+    assert cal.provenance["ici_bw_s"]["method"] == "prior"
+
+
+# -- degenerate rejection ----------------------------------------------------
+
+
+def test_fit_rejects_zero_byte_hop():
+    with pytest.raises(CalibrationError, match="zero-byte"):
+        fit_constants([hop(0, "raw", enc_bw=1e9)], bench_memory=False)
+
+
+def test_fit_rejects_undersampled_histogram():
+    bad = hop(1 << 20, "raw", enc_bw=1e9, n=3)  # 0 < 3 < min_samples
+    with pytest.raises(CalibrationError, match="only 3 sample"):
+        fit_constants([bad], bench_memory=False)
+
+
+def test_fit_rejects_empty():
+    with pytest.raises(CalibrationError, match="no hop telemetry"):
+        fit_constants([], bench_memory=False)
+
+
+def test_zero_count_is_legitimate_absence():
+    """count == 0 is a tier working as designed (an ici hop records no
+    host_sync), NOT a degenerate fit — must not raise."""
+    rec = hop(1 << 20, "ici", tier="ici", tx_s=32 * (1 << 20) / 4.5e10)
+    assert rec["host_sync_s"] == {"count": 0}
+    fit_constants([rec], bench_memory=False)  # no raise
+
+
+# -- the artifact ------------------------------------------------------------
+
+
+def test_artifact_roundtrip(tmp_path):
+    cal = fit_constants([hop(1 << 20, "lzb", enc_bw=2e9, dec_bw=1e9,
+                             hs_bw=5e9, link_bw=1e8)],
+                        gen="v4", bench_memory=False)
+    p = tmp_path / "cal.json"
+    cal.save(str(p))
+    back = CalibratedConstants.load(str(p))
+    assert back.to_json() == cal.to_json()
+    assert back.schema == SCHEMA
+    assert isinstance(back.codecs["lzb"], CodecSpec)
+
+
+def test_artifact_rejects_unknown_schema():
+    with pytest.raises(CalibrationError, match="schema"):
+        CalibratedConstants.from_json({"schema": "bogus.v9"})
+
+
+def test_apply_overlays_without_mutating():
+    g = dense_chain([8, 8, 8])
+    cost = StageCostModel(g, gen="v4", link_bw_s=1e9)
+    cal = CalibratedConstants(host_sync_bw_s=2e9, link_bw_s=5e7,
+                              codecs={"weird": CodecSpec(
+                                  name="weird", ratio=1.0,
+                                  encode_bytes_per_s=1e9,
+                                  decode_bytes_per_s=1e9, lossy=False)})
+    out = cal.apply(cost)
+    assert out is not cost
+    assert out.host_sync_bw_s == 2e9 and out.link_bw_s == 5e7
+    assert "weird" in out.codecs and "raw" in out.codecs  # merge
+    assert cost.link_bw_s == 1e9 and "weird" not in cost.codecs
+    # unfitted fields keep the model's own values
+    assert out.local_bw_s == cost.local_bw_s
+
+
+# -- plan-JSON roundtrip -----------------------------------------------------
+
+
+def test_calibration_survives_plan_json_roundtrip():
+    """Calibrated model -> evaluate_cuts (deployed-codec pin) -> to_json
+    -> plan_from_json -> cost_model_from_plan must reproduce the same
+    per-stage service predictions — including a codec name the default
+    table has no row for, and the plan's batch."""
+    g = dense_chain([8, 16, 8, 8])
+    cuts = [g.topo_order[1], g.topo_order[2]]
+    node_costs = {n: 1e-4 for n in g.topo_order}
+    cost = StageCostModel(g, gen="v4", batch=4, link_bw_s=1e9,
+                          node_costs=node_costs)
+    raw = cost.cut_bytes(cuts[0])
+    cal = fit_constants(
+        [hop(raw, "dsleep5+raw", enc_bw=2e9, dec_bw=raw / 5e-3,
+             cut=cuts[0])], bench_memory=False)
+    cal_cost = cal.apply(cost)
+    deployed = ["dsleep5+raw", "raw"]
+    pred = predict_stage_service_s(g, cuts, deployed, cal_cost)
+
+    plan = evaluate_cuts(g, cuts, cal_cost, hop_codecs=deployed)
+    assert plan.codecs == deployed
+    doc = json.loads(json.dumps(plan.to_json()))
+    restored = cost_model_from_plan(g, plan_from_json(doc))
+    assert restored.batch == 4
+    assert "dsleep5+raw" in restored.codecs
+    back = predict_stage_service_s(g, cuts, deployed, restored)
+    for a, b in zip(back, pred):
+        assert a == pytest.approx(b, rel=1e-3)
+
+
+def test_evaluate_cuts_hop_codecs_validation():
+    g = dense_chain([8, 8, 8, 8])
+    cost = StageCostModel(g, gen="v4",
+                          node_costs={n: 1e-4 for n in g.topo_order})
+    cut = g.topo_order[2]
+    with pytest.raises(ValueError, match="hop codecs"):
+        evaluate_cuts(g, [cut], cost, hop_codecs=["raw", "raw"])
+    with pytest.raises(ValueError, match="replicas"):
+        evaluate_cuts(g, [cut], cost, hop_codecs=["raw"],
+                      replicas=[1, 2])
+
+
+# -- measurement-aligned prediction ------------------------------------------
+
+
+def test_predict_stage_service_alignment():
+    """Stage k = max(compute, inbound decode, outbound encode) with
+    CODEC-ONLY parts; hop comm never lands on the wrong stage."""
+    g = dense_chain([8, 8, 8])
+    cuts = [g.topo_order[0], g.topo_order[1]]
+    node_costs = {n: 1e-3 for n in g.topo_order}
+    cost = StageCostModel(g, gen="v4", link_bw_s=1e9,
+                          node_costs=node_costs)
+    slow = CodecSpec(name="slowdec", ratio=1.0,
+                     encode_bytes_per_s=1e12,
+                     decode_bytes_per_s=10.0, lossy=False)
+    cost.codecs = {**cost.codecs, "slowdec": slow}
+    pred = predict_stage_service_s(g, cuts, ["slowdec", "raw"], cost)
+    dec = cost.cut_bytes(cuts[0]) / 10.0
+    # the expensive decode binds the RECEIVING stage (1), not stage 0
+    assert pred[1] == pytest.approx(max(dec, pred[0]), rel=1e-9)
+    assert pred[0] < dec
+    # tier pseudo-codecs do no codec work: pure per-stage compute
+    order = g.topo_order
+    bounds = [0, order.index(cuts[0]) + 1, order.index(cuts[1]) + 1,
+              len(order)]
+    compute = [cost.compute_seconds(order[a:b])
+               for a, b in zip(bounds, bounds[1:])]
+    none = predict_stage_service_s(g, cuts, ["ici", "local"], cost)
+    assert none == pytest.approx(compute, rel=1e-9)
+    # length mismatch is loud
+    with pytest.raises(ValueError, match="hop codecs"):
+        predict_stage_service_s(g, cuts, ["raw"], cost)
+
+
+def test_codec_only_parts_unknown_falls_back_to_raw():
+    g = dense_chain([8, 8])
+    cost = StageCostModel(g, gen="v4",
+                          node_costs={n: 1e-4 for n in g.topo_order})
+    cut = g.topo_order[1]
+    assert codec_only_parts(cost, cut, "never-heard-of-it") == \
+        codec_only_parts(cost, cut, "raw")
+    assert codec_only_parts(cost, cut, "device") == (0.0, 0.0)
+
+
+# -- stats reshaping ---------------------------------------------------------
+
+
+def stats_row(stage, codec, *, enc=None, dec=None, hs=None, tx=None,
+              replica=None, tier="tcp"):
+    return {"stage": stage, "replica": replica, "codec": codec,
+            "tier": tier,
+            "encode_latency_s": enc or {"count": 0},
+            "decode_latency_s": dec or {"count": 0},
+            "host_sync_s": hs or {"count": 0},
+            "tx_s": tx or {"count": 0}}
+
+
+def test_hop_telemetry_from_stats_joins_sides():
+    """Hop k joins stage k's encode/host-sync/send with stage k+1's
+    decode (measured at the receiver); raw bytes come from the graph."""
+    g = dense_chain([8, 8, 8])
+    cuts = [g.topo_order[1]]
+    stats = [
+        stats_row(0, "lzb", enc=summ(16, 0.016), hs=summ(16, 0.008),
+                  tx=summ(16, 0.032)),
+        stats_row(1, "raw", dec=summ(16, 0.160)),
+    ]
+    hops = hop_telemetry_from_stats(g, cuts, stats, batch=2)
+    assert len(hops) == 1
+    h = hops[0]
+    spec = g.out_spec(cuts[0])
+    assert h["raw_bytes"] == spec.size * spec.dtype.itemsize * 2
+    assert h["codec"] == "lzb"           # the SENDER's codec
+    assert h["enc_s"]["sum"] == pytest.approx(0.016)
+    assert h["dec_s"]["sum"] == pytest.approx(0.160)
+
+
+def test_hop_telemetry_window_bounds_against_baseline():
+    g = dense_chain([8, 8, 8])
+    cuts = [g.topo_order[1]]
+    base = [stats_row(0, "lzb", enc=summ(8, 0.8)),
+            stats_row(1, "raw", dec=summ(8, 0.8))]
+    now = [stats_row(0, "lzb", enc=summ(24, 0.96)),
+           stats_row(1, "raw", dec=summ(24, 0.96))]
+    h = hop_telemetry_from_stats(g, cuts, now, baseline=base)[0]
+    # only the NEW 16 samples (sum 0.16) anchor the fit
+    assert h["enc_s"] == {"count": 16, "sum": pytest.approx(0.16)}
+    assert h["dec_s"] == {"count": 16, "sum": pytest.approx(0.16)}
+
+
+def test_hop_telemetry_pools_replicas():
+    g = dense_chain([8, 8, 8])
+    cuts = [g.topo_order[1]]
+    stats = [
+        stats_row(0, "raw", enc=summ(8, 0.08), replica=0),
+        stats_row(0, "raw", enc=summ(8, 0.24), replica=1),
+        stats_row(1, "raw", dec=summ(16, 0.16)),
+    ]
+    h = hop_telemetry_from_stats(g, cuts, stats)[0]
+    assert h["enc_s"] == {"count": 16, "sum": pytest.approx(0.32)}

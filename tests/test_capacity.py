@@ -1,0 +1,235 @@
+"""Capacity accounting and drift auditing (obs/capacity.py), plus the
+window-bounded measurement forms they score against (obs/cluster.py
+rolling delta-means, replan's baseline-windowed folds)."""
+
+import pytest
+
+from defer_tpu import GraphBuilder
+from defer_tpu.graph import ops
+from defer_tpu.obs import (CapacityModel, ClusterView, DriftAuditor,
+                           achieved_mfu, stage_flops_bytes)
+from defer_tpu.obs.capacity import stages_from_cuts
+from defer_tpu.obs.cluster import _win_mean_ms
+from defer_tpu.obs.events import recorder
+from defer_tpu.plan import measured_stage_seconds
+from defer_tpu.utils import hw
+
+
+def dense_chain(widths, name="chain", in_width=8):
+    b = GraphBuilder(name)
+    x = b.input((in_width,))
+    for i, w in enumerate(widths):
+        x = b.add(ops.Dense(w), x, name=f"fc{i}")
+    return b.build()
+
+
+# -- analytic side -----------------------------------------------------------
+
+
+def test_stage_flops_bytes_scales_with_batch():
+    g = dense_chain([8, 8])
+    f1, b1 = stage_flops_bytes(g, g.topo_order, batch=1)
+    f4, b4 = stage_flops_bytes(g, g.topo_order, batch=4)
+    assert f1 > 0 and b1 > 0
+    assert f4 == pytest.approx(4 * f1) and b4 == pytest.approx(4 * b1)
+
+
+def test_achieved_mfu_honest_denominator_policy():
+    # no peak / no time / no flops: None, never a fabricated 0.0
+    assert achieved_mfu(1e9, 1e-3, 0.0) is None
+    assert achieved_mfu(1e9, 0.0, 1e12) is None
+    assert achieved_mfu(0.0, 1e-3, 1e12) is None
+    assert achieved_mfu(1e12, 1.0, 2e12) == pytest.approx(0.5)
+
+
+def test_stages_from_cuts_partitions_topo_order():
+    g = dense_chain([8, 8, 8, 8])
+    order = g.topo_order
+    stages = stages_from_cuts(g, [order[0], order[2]])
+    assert stages == [order[:1], order[1:3], order[3:]]
+    assert [n for s in stages for n in s] == order
+
+
+def test_capacity_model_known_gen():
+    g = dense_chain([8, 8, 8])
+    cut = g.topo_order[1]
+    cap = CapacityModel(g, [cut], batch=2, gen="v4")
+    assert cap.num_stages == 2
+    assert cap.peak_flops_s == hw.peak_flops("v4") > 0
+    for k in range(2):
+        assert cap.stage_flops[k] > 0
+        assert cap.roofline_s(k) > 0
+        # a measured time at exactly the compute bound -> MFU sanity
+        t = cap.stage_flops[k] / cap.peak_flops_s
+        assert cap.mfu(k, t) == pytest.approx(1.0)
+        assert cap.mfu(k, 2 * t) == pytest.approx(0.5)
+        assert 0 < cap.roofline_util(k, 2 * cap.roofline_s(k)) <= 0.5
+    # chain MFU: both stages at the bottleneck for one interval
+    bott = max(cap.stage_flops) / cap.peak_flops_s
+    want = sum(cap.stage_flops) / (bott * cap.peak_flops_s * 2)
+    assert cap.chain_mfu(bott) == pytest.approx(want)
+    doc = cap.to_json()
+    assert doc["gen"] == "v4"
+    assert all(r is not None for r in doc["roofline_ms"])
+
+
+def test_capacity_model_unknown_gen_yields_none_not_zero():
+    g = dense_chain([8, 8])
+    cut = g.topo_order[0]
+    cap = CapacityModel(g, [cut], gen="tpu-v99")
+    assert cap.peak_flops_s == 0.0  # no v5e fallback here
+    assert cap.mfu(0, 1e-3) is None
+    assert cap.roofline_s(0) is None
+    assert cap.roofline_util(0, 1e-3) is None
+    assert cap.chain_mfu(1e-3) is None
+    assert cap.to_json()["roofline_ms"] == [None, None]
+    # an explicit override restores the numbers
+    over = CapacityModel(g, [cut], gen="tpu-v99", peak_flops_s=1e12,
+                         hbm_bw_s=1e11)
+    assert over.mfu(0, 1e-3) is not None
+
+
+# -- drift auditor -----------------------------------------------------------
+
+
+class FakeView:
+    """Stands in for ClusterView: serves a scripted window-bounded
+    measurement map."""
+
+    def __init__(self):
+        self.measured = {}
+        self.windows = []
+
+    def stage_service_ms(self, *, window=None):
+        self.windows.append(window)
+        return dict(self.measured)
+
+
+def drift_events():
+    return [e for e in recorder().snapshot()
+            if e["kind"] == "model_drift"]
+
+
+def test_drift_auditor_sustain_and_single_event_per_episode():
+    recorder().clear()
+    view = FakeView()
+    aud = DriftAuditor([10.0, 20.0], threshold=0.25, sustain=2, window=6)
+    view.measured = {0: 10.5, 1: 21.0}          # within threshold
+    assert aud.observe(view) == []
+    assert view.windows[-1] == 6                 # audits the window form
+    view.measured = {0: 14.0, 1: 21.0}           # stage 0 over (+40%)
+    assert aud.observe(view) == []               # 1 interval < sustain
+    flags = aud.observe(view)                    # 2nd interval: flag
+    assert [f.stage for f in flags] == [0]
+    assert flags[0].intervals == 2
+    assert flags[0].rel_err == pytest.approx(0.4)
+    assert len(drift_events()) == 1
+    flags = aud.observe(view)                    # sustained: flags again
+    assert flags and flags[0].intervals == 3
+    assert len(drift_events()) == 1              # but only ONE event
+    ev = drift_events()[0]["data"]
+    assert ev["stage"] == 0 and ev["predicted_ms"] == 10.0
+    # recovery re-arms the episode
+    view.measured = {0: 10.2, 1: 21.0}
+    assert aud.observe(view) == []
+    view.measured = {0: 30.0, 1: 21.0}
+    aud.observe(view)
+    assert aud.observe(view)
+    assert len(drift_events()) == 2
+
+
+def test_drift_audit_rows_need_both_numbers():
+    recorder().clear()
+    view = FakeView()
+    aud = DriftAuditor([10.0, 20.0], threshold=0.1, sustain=1)
+    view.measured = {0: 50.0}                    # stage 1 not measured yet
+    flags = aud.observe(view)
+    assert aud.last[1]["err"] is None            # no fabricated error
+    assert aud.last[0]["err"] == pytest.approx(4.0)
+    assert [f.stage for f in flags] == [0]       # only the measured stage
+    # an unmeasured stage never drifts, no matter how long
+    assert all(f.stage == 0 for f in aud.observe(view))
+
+
+# -- window-bounded measurement (the numbers the auditor scores) -------------
+
+
+def push(count, total, *, p50=None, stage=0, replica=0, phase="infer_s"):
+    summ = {"count": count, "sum": total,
+            "p50": p50 if p50 is not None else total / max(count, 1)}
+    return {"node": {"stage": stage, "replica": replica},
+            "latency": {phase: summ}}
+
+
+def test_win_mean_ms_is_a_delta_not_a_fold():
+    h = [(0.0, push(10, 0.010)), (1.0, push(20, 0.030)),
+         (2.0, push(30, 0.110))]
+    # window mean = (0.110 - 0.010) / (30 - 10) = 5 ms
+    assert _win_mean_ms(h, "infer_s") == pytest.approx(5.0)
+    # no new samples -> None (idle chain must not read as 0 ms)
+    assert _win_mean_ms([h[0], h[0]], "infer_s") is None
+    assert _win_mean_ms(h, "decode_s") is None
+
+
+def test_stage_service_ms_windowed_tracks_regime_shift():
+    view = ClusterView()
+    # 10 pushes in a 1 ms/frame regime...
+    n = s = 0
+    for i in range(10):
+        n, s = n + 8, s + 8 * 0.001
+        view.ingest(push(n, s, p50=1e-3))
+    # ...then 4 pushes at 5 ms/frame; the cumulative p50 stays ~1 ms
+    for i in range(4):
+        n, s = n + 8, s + 8 * 0.005
+        view.ingest(push(n, s, p50=1e-3))
+    lifetime = view.stage_service_ms()
+    windowed = view.stage_service_ms(window=4)
+    assert lifetime[0] == pytest.approx(1.0)
+    assert windowed[0] == pytest.approx(5.0, rel=0.01)
+
+
+def test_stage_service_ms_window_falls_back_to_lifetime():
+    view = ClusterView()
+    view.ingest(push(8, 0.016, p50=2e-3))        # a single push
+    assert view.stage_service_ms(window=4)[0] == pytest.approx(2.0)
+    view.ingest(push(8, 0.016, p50=2e-3))        # no new samples either
+    assert view.stage_service_ms(window=4)[0] == pytest.approx(2.0)
+
+
+def test_measured_stage_seconds_windowed_stats_list():
+    base = [{"stage": 0, "replica": 0,
+             "infer_latency_s": {"count": 10, "sum": 0.010, "p50": 1e-3}}]
+    now = [{"stage": 0, "replica": 0,
+            "infer_latency_s": {"count": 30, "sum": 0.060, "p50": 1e-3}}]
+    # delta mean (0.05 / 20) beats the lifetime p50
+    got = measured_stage_seconds(now, baseline=base)
+    assert got[0] == pytest.approx(2.5e-3)
+    # without a baseline: the lifetime quantile
+    assert measured_stage_seconds(now)[0] == pytest.approx(1e-3)
+    # baseline with no new samples: keep the lifetime figure
+    assert measured_stage_seconds(base, baseline=base)[0] == \
+        pytest.approx(1e-3)
+
+
+def test_measured_stage_seconds_windowed_registry_form():
+    base = {"pipe.stage0.latency_s": {"count": 4, "sum": 0.004,
+                                      "p50": 1e-3}}
+    now = {"pipe.stage0.latency_s": {"count": 12, "sum": 0.036,
+                                     "p50": 1e-3}}
+    assert measured_stage_seconds(now, baseline=base)[0] == \
+        pytest.approx(4e-3)
+
+
+# -- rows() carries the node-side capacity fields ----------------------------
+
+
+def test_rows_surface_capacity_fields():
+    view = ClusterView()
+    p = push(8, 0.016, p50=2e-3)
+    p["capacity"] = {"flops": 2.5e6, "mfu": 0.125,
+                     "achieved_flops_s": 1.25e9}
+    view.ingest(p)
+    row = view.rows()[0]
+    assert row["flops"] == 2.5e6
+    assert row["mfu"] == 0.125
+    assert row["achieved_flops_s"] == 1.25e9
