@@ -343,8 +343,19 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: zero ring drops at default capacity; and recorder+tracing overhead
 #: stays < 5% vs telemetry-off on the interleaved min-of-3 protocol
 #: obs_overhead established)
+#: ... and `pipeline_failover` (the seq-replay substrate's chaos row:
+#: kill -9 a mid-chain stage-1 replica while the stream is in flight —
+#: the supervisor respawns it, the upstream fan-out heals and replays
+#: its unacked window, and the run must end byte-identical to an
+#: undisturbed reference; the row's value is the healed hop's measured
+#: recovery wall time (ms) from its `failover` flight-recorder event,
+#: and the same row carries the zero-downtime live-replan leg: a
+#: mid-stream quiesce -> redeploy -> resume cutover onto the same
+#: persist processes, byte-identical with its cutover_ms —
+#: docs/ROBUSTNESS.md)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
+    "pipeline_failover": "chaos_smoke.py",
     "ici_fastpath": "ici_smoke.py",
     "plan_vs_quantile": "plan_smoke.py",
     "stage_replication": "replication_smoke.py",

@@ -641,7 +641,8 @@ def cmd_node(args):
                          join_in=args.join if primary else 0,
                          infer_delay_s=args.infer_delay_ms / 1e3
                          if primary else 0.0,
-                         tier=tier, tier_accept=accept, device=device)
+                         tier=tier, tier_accept=accept, device=device,
+                         failover=args.failover, persist=args.persist)
         what = (f"stage {node.manifest['index']} "
                 f"({node.manifest['name']})"
                 if node.manifest else "EMPTY (awaiting in-band deploy)")
@@ -858,7 +859,8 @@ def cmd_chain(args):
                      hop_tiers=hop_tiers, tier=args.tier,
                      devices=args.devices, device_map=device_map,
                      stats_out=stats,
-                     trace_sample_every=args.trace_sample)
+                     trace_sample_every=args.trace_sample,
+                     failover=args.failover)
     dt = time.perf_counter() - t0
 
     fwd = jax.jit(graph.apply)
@@ -1635,6 +1637,19 @@ def main(argv=None):
                          "exactly when --tier is not tcp; a stage "
                          "whose own outbound is tcp may still be the "
                          "colocated-tier TARGET of its upstream)")
+    nd.add_argument("--failover", action="store_true",
+                    help="arm the seq-replay substrate on this node "
+                         "(docs/ROBUSTNESS.md): a fan-out retains sent "
+                         "frames until the downstream merge acks them "
+                         "and self-heals dead replica channels; a "
+                         "replica relays acks upstream; a fan-in "
+                         "tolerates upstream death within a grace "
+                         "window and dedups replayed frames")
+    nd.add_argument("--persist", action="store_true",
+                    help="survive stream END: keep serving segments "
+                         "until a 'shutdown' control frame arrives "
+                         "(the live-replan node mode — a quiesce/"
+                         "redeploy/resume cycle reuses this process)")
     nd.add_argument("--co-stage", action="append", default=[],
                     metavar="SPEC",
                     help="host an additional stage node in THIS process "
@@ -1668,6 +1683,15 @@ def main(argv=None):
                    help="run stage K as R data-parallel replica "
                         "processes (ordered fan-out/fan-in; adjacent "
                         "stages cannot both be replicated)")
+    c.add_argument("--failover", action="store_true",
+                   help="arm the seq-replay substrate (docs/"
+                        "ROBUSTNESS.md): fan-outs retain frames until "
+                        "acked and self-heal dead replica channels, a "
+                        "supervisor respawns killed replica processes, "
+                        "and the stream completes byte-identical — "
+                        "requires an interior replicated stage "
+                        "(--replicas) and file-based artifacts "
+                        "(no --in-band)")
     c.add_argument("--trace-sample", type=int, default=0, metavar="N",
                    help="waterfall sampling: with --trace-out, stamp "
                         "every frame with its stream sequence number "

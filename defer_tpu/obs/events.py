@@ -59,6 +59,20 @@ EVENT_KINDS = {
     "decode_cancel": "a decode request's slot was reclaimed (rid)",
     "model_drift": "a stage's measured service drifted from the cost "
                    "model's prediction (stage, rel_err)",
+    "redial": "a connect_retry attempt failed and backed off "
+              "(addr, attempt, delay_ms, error)",
+    "replica_lost": "a fan-in upstream connection died mid-stream "
+                    "(hop, error)",
+    "failover": "a replay fan-out healed a dead channel "
+                "(hop, chan, addr, replayed, recovery_ms)",
+    "quiesce": "a stage drained to a stable sequence point "
+               "(hop, processed)",
+    "cutover": "a live replan cut the chain over mid-stream "
+               "(stages, quiesced)",
+    "backend_lost": "the serve front door's chain backend died "
+                    "(error, shed)",
+    "replica_respawn": "the chain supervisor respawned a dead replica "
+                       "(stage, replica, addr, rc)",
 }
 
 #: the wire schema's required keys (and the only keys)

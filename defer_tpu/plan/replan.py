@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import time
+from typing import Sequence
 
 from ..graph.ir import LayerGraph
 from .cost import CodecSpec, StageCostModel
@@ -163,6 +165,97 @@ class ReplanResult:
             "old_corrected": self.old_plan_corrected.to_json(),
             "new": self.new_plan.to_json(),
         }
+
+    def apply(self, live: "LiveReplan", *,
+              min_improvement: float = 1.0) -> dict | None:
+        """Act on the suggestion: cut the live chain over to
+        ``new_plan`` through ``live`` (quiesce -> redeploy -> resume,
+        docs/ROBUSTNESS.md).  Returns the cutover receipt, or None when
+        the suggestion moved nothing / predicts less than
+        ``min_improvement`` — a suggestion that is not worth a cutover
+        should cost nothing."""
+        if not self.moved or self.predicted_improvement < min_improvement:
+            return None
+        return live.apply(self.new_plan)
+
+
+class LiveReplan:
+    """Zero-downtime mid-stream replan over persist-mode stage nodes.
+
+    The replay/quiesce substrate's second consumer (the first is
+    replica failover — docs/ROBUSTNESS.md): between stream segments,
+    quiesce every stage at a stable sequence point, end the segment's
+    data-plane connections (the dispatcher's result server and sequence
+    counter survive — :meth:`ChainDispatcher.end_stream`), ship the
+    re-cut stage artifacts over the SAME in-band deploy path that
+    booted the chain, and resume streaming.  The nodes never restart,
+    no port moves, and the output stream stays byte-identical to an
+    undisturbed run because the cutover sits exactly on a segment
+    boundary.
+
+    Requires every node to run ``--persist`` (survive stream END until
+    an explicit ``shutdown``) — the constructor cannot verify that, so
+    a non-persist node surfaces as a connect failure on the segment
+    after the first cutover.
+
+    The cutover redeploys onto the SAME process set: ``new_plan.cuts``
+    must produce ``len(node_addrs)`` stages (replica-count changes need
+    a supervisor respawn, which is failover's mechanism, not this one).
+    """
+
+    def __init__(self, dispatcher, graph, params,
+                 node_addrs: Sequence, *, batch: int = 1,
+                 codecs: Sequence[str] | None = None,
+                 quiesce_timeout_s: float = 30.0):
+        self.dispatcher = dispatcher
+        self.graph = graph
+        self.params = params
+        self.node_addrs = list(node_addrs)
+        self.batch = batch
+        self.codecs = list(codecs) if codecs else None
+        self.quiesce_timeout_s = quiesce_timeout_s
+        #: cutovers performed (the obs counter's pull twin)
+        self.cutovers = 0
+
+    def apply(self, new_plan, *, at_seq: int | None = None) -> dict:
+        """One cutover: quiesce -> end segment -> in-band redeploy ->
+        ready for the next ``stream`` segment.  Returns a receipt dict
+        (per-stage quiesced counts, stage count, recovery time)."""
+        from ..obs.events import emit as _emit
+        from ..partition.partitioner import partition
+
+        t0 = time.perf_counter()
+        disp = self.dispatcher
+        stages = partition(self.graph, list(new_plan.cuts))
+        if len(stages) != len(self.node_addrs):
+            raise ValueError(
+                f"plan cuts produce {len(stages)} stages but the live "
+                f"chain has {len(self.node_addrs)} nodes — a live "
+                f"replan keeps the process set")
+        processed = disp.quiesce(self.node_addrs, at_seq=at_seq,
+                                 timeout_s=self.quiesce_timeout_s)
+        disp.end_stream()
+        # plan codecs are per CUT (N-1 interior hops); deploy wants one
+        # OUTBOUND codec per stage — the exit stage's result hop rides
+        # the dispatcher default
+        codecs = self.codecs
+        if getattr(new_plan, "codecs", None):
+            codecs = list(new_plan.codecs) + [disp.codec]
+        disp.deploy(stages, self.params, self.node_addrs,
+                    batch=self.batch, codecs=codecs)
+        self.cutovers += 1
+        receipt = {"stages": len(stages),
+                   "quiesced": processed,
+                   "cuts": list(new_plan.cuts),
+                   "cutover_ms": round(
+                       (time.perf_counter() - t0) * 1e3, 3)}
+        _emit("cutover", stages=len(stages), quiesced=processed)
+        return receipt
+
+    def shutdown(self) -> None:
+        """Release the persist nodes: send each the ``shutdown``
+        control command so their serve loops return."""
+        self.dispatcher.shutdown_nodes(self.node_addrs)
 
 
 def cost_model_from_plan(graph: LayerGraph, plan: Plan) -> StageCostModel:

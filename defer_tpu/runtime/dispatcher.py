@@ -60,9 +60,17 @@ class DeferHandle:
         self._gen: int = 0
         #: completed watchdog recoveries (rebuild + replay)
         self.recoveries: int = 0
-        #: fed-but-not-yet-emitted real microbatch inputs, in feed order —
-        #: the bounded resubmit log a recovery generation replays
-        self._resubmit: collections.deque = collections.deque()
+        #: fed-but-not-yet-emitted real microbatch inputs, seq-stamped —
+        #: the same retain-until-ack window the network failover path
+        #: uses (``transport/replay.py``), here with "ack" = "output
+        #: emitted": a recovery generation replays ``unacked()``.
+        #: Assigned by ``run_defer`` once the pipeline's chunk depth
+        #: (the window bound) is known.
+        self._resubmit = None
+        #: next feed seq to stamp / cumulative outputs emitted — the
+        #: producer/consumer cursors of the resubmit window
+        self._fed: int = 0
+        self._emitted: int = 0
         #: True once END_OF_STREAM was consumed from the input queue — a
         #: recovery generation must not wait for a second END (the caller
         #: already sent theirs); it replays, flushes, and exits
@@ -610,11 +618,18 @@ class Defer:
         ``END_OF_STREAM`` (None) on the input queue — or call
         ``handle.stop()`` — to shut down after draining the pipe.
         """
+        from ..transport.replay import ReplayBuffer
+
         pipe = self.build(graph, params, cut_points, num_stages)
         stop = threading.Event()
         cfg = self.config
         disp_count = REGISTRY.counter("dispatcher.dispatches")
         disp_hist = REGISTRY.histogram("dispatcher.dispatch_s")
+        # the resubmit window's bound: everything a pipeline can hold
+        # fed-but-unemitted, with slack for the gather in progress (the
+        # MPMD path never logs — its capacity is a placeholder)
+        log_cap = 1 if isinstance(pipe, MpmdPipeline) \
+            else 2 * (pipe.chunk + pipe.num_stages + 1)
 
         def _dispatch(gen, fn, *a, arm=True, **kw):
             # bracket device work so the watchdog can tell "waiting for
@@ -696,7 +711,6 @@ class Defer:
 
             # ---- SPMD path: resubmit log + replay-aware input feed ----
             log = handle._resubmit
-            log_cap = 2 * (pipe.chunk + pipe.num_stages + 1)
             pending: collections.deque = collections.deque(replay)
 
             def next_input(timeout: float):
@@ -749,10 +763,15 @@ class Defer:
                 # record the fed microbatches BEFORE dispatch: if the
                 # dispatch wedges, the recovery generation replays exactly
                 # these (plus everything older still in the pipe)
-                log.extend(batch)
-                if len(log) > log_cap:  # can't happen: pops track emits
-                    raise RuntimeError(
-                        f"resubmit log overflow ({len(log)} > {log_cap})")
+                for x in batch:
+                    if log.depth() >= log.capacity:
+                        # can't happen: acks track emits.  Raise instead
+                        # of letting retain() block on the bug.
+                        raise RuntimeError(
+                            f"resubmit log overflow ({log.depth()} >= "
+                            f"{log.capacity})")
+                    log.retain(handle._fed, x)
+                    handle._fed += 1
                 # materialize inside the bracket (push is async dispatch;
                 # the device block happens at np.asarray)
                 outs = _dispatch(
@@ -762,7 +781,10 @@ class Defer:
                 if not live():
                     return  # watchdog fired mid-dispatch; sentinel is out
                 for o in outs:
-                    log.popleft()  # emitted: no longer replayable
+                    # emitted: no longer replayable (cumulative ack, the
+                    # in-process twin of the fan-in's replay_ack)
+                    handle._emitted += 1
+                    log.ack(handle._emitted)
                     output_stream.put(o)
             if not live():
                 return
@@ -774,7 +796,8 @@ class Defer:
                 # violate the stream protocol for readers
                 return
             for o in outs:
-                log.popleft()
+                handle._emitted += 1
+                log.ack(handle._emitted)
                 output_stream.put(o)
 
         def start_generation(pipe, replay, gen):
@@ -793,6 +816,8 @@ class Defer:
             t.start()
 
         handle = DeferHandle(None, pipe, stop)
+        handle._resubmit = ReplayBuffer(log_cap,
+                                        gauge="dispatcher.replay_depth")
         start_generation(pipe, [], 0)
 
         if cfg.watchdog_s is not None:
@@ -823,8 +848,16 @@ class Defer:
                                        gen=handle._gen,
                                        stalled_s=round(
                                            time.monotonic() - busy, 3))
-                            replay = list(handle._resubmit)
-                            handle._resubmit.clear()
+                            t_rec = time.perf_counter()
+                            # the unacked window IS the replay set; the
+                            # recovery generation re-feeds (re-retains)
+                            # it through the normal path, so it gets a
+                            # fresh window and a fresh seq space
+                            replay = [v for _, v
+                                      in handle._resubmit.unacked()]
+                            handle._resubmit = ReplayBuffer(
+                                log_cap, gauge="dispatcher.replay_depth")
+                            handle._fed = handle._emitted = 0
                             try:
                                 new_pipe = self.build(graph, params,
                                                       cut_points, num_stages)
@@ -834,6 +867,16 @@ class Defer:
                                 output_stream.put(END_OF_STREAM)
                                 return
                             start_generation(new_pipe, replay, handle._gen)
+                            # same event the network heal emits: one
+                            # vocabulary for "a hop died and its unacked
+                            # window was replayed", wherever the hop is
+                            emit_event(
+                                "failover", hop="dispatcher",
+                                chan=handle._gen, addr="in-process",
+                                replayed=len(replay),
+                                recovery_ms=round(
+                                    (time.perf_counter() - t_rec) * 1e3,
+                                    3))
                             continue
                         # out of recoveries (or MPMD): a dead device/backend
                         # surfaces instead of the reference's forever-hang

@@ -47,12 +47,24 @@ from ..transport.framed import (K_ACK, K_BYTES, K_CTRL, K_END, K_TENSOR,
                                 connect_retry, recv_expect, recv_frame,
                                 send_ack, send_ctrl, send_end, send_frame)
 from ..transport.branch import BranchJoin, BroadcastSender
+from ..transport.replay import ACK_EVERY, ReplayFanOut
 from ..transport.replicate import FanInMerge, FanOutSender
 
 
 #: guards lazy creation of per-node watermark splitters (``__new__``-
 #: built test stubs have no __init__ to create one in)
 _WM_LOCK = threading.Lock()
+
+#: serve()-loop sentinel a ``shutdown`` control command enqueues: a
+#: persistent node returns its accumulated stream total NOW
+_SHUTDOWN = object()
+
+#: fan-in dedup window under failover: how far behind the merge head a
+#: replayed duplicate may land and still be absorbed silently.  Bounds
+#: the fan-out's retained window (ack lag + reorder capacity) with an
+#: order of magnitude of slack — beyond it, a duplicate is a protocol
+#: bug and raises exactly as in strict mode.
+_REPLAY_DEDUP_WINDOW = 4096
 
 
 def _connect_retry(host: str, port: int, timeout_s: float = 30.0
@@ -151,6 +163,27 @@ class StageNode:
     #: waterfall sampling period carried by the trace context (0 = every
     #: frame records spans, N >= 1 = only wire-seq multiples of N)
     trace_sample_every: int = 0
+    #: seq-replay failover substrate (docs/ROBUSTNESS.md): fan-out hops
+    #: retain sent frames until the downstream fan-in's cumulative
+    #: ``replay_ack`` and HEAL dead replica channels (redial + replay);
+    #: replica hops relay acks upstream; fan-in hops ack, dedup replay
+    #: overlaps, and tolerate a replica's mid-stream EOF for one redial
+    #: grace period
+    failover: bool = False
+    #: keep serving across stream segments: serve() accumulates per-
+    #: stream tensor counts and returns only on a ``shutdown`` control
+    #: command — the node half of a zero-downtime live replan
+    #: (quiesce -> redeploy -> resume, docs/ROBUSTNESS.md)
+    persist: bool = False
+    #: redial grace a fan-in allows a dead upstream before poisoning
+    #: the merge (the chain supervisor's respawn must beat this)
+    failover_grace_s: float = 30.0
+    #: live fan-in data connections (the ack plane's targets); class
+    #: default covers ``__new__``-built stubs
+    _fanin_conns: list | None = None
+    #: bumped per fan-in data-path registration — a respawned replica's
+    #: dial-in inside the grace period cancels the delayed poisoning
+    _fanin_epoch: int = 0
     #: live data-path channels (set once a connection proves to be the
     #: stream) — what obs_push reads queue depths/watermarks from
     _live_rx = None
@@ -193,7 +226,8 @@ class StageNode:
                  fan_mode: str = "rr", branch: int | None = None,
                  join_in: int = 0, infer_delay_s: float = 0.0,
                  tier: str = "tcp", tier_accept: bool = True,
-                 device: int | None = None):
+                 device: int | None = None, failover: bool = False,
+                 persist: bool = False):
         # bind before the (slow: jax import + StableHLO deserialize)
         # artifact load so upstream connect-retries land as soon as the
         # process exists
@@ -248,6 +282,10 @@ class StageNode:
         #: connections and the single compute loop (lazy, lock-guarded)
         self._merge: FanInMerge | None = None
         self._merge_lock = threading.Lock()
+        self.failover = bool(failover)
+        self.persist = bool(persist)
+        self._fanin_conns = None
+        self._fanin_epoch = 0
         #: branch-join state: the (path, seq) reorder buffer shared by
         #: the P labeled upstream readers and one compute loop
         self._join: BranchJoin | None = None
@@ -420,11 +458,25 @@ class StageNode:
                                  hist="node.tx_s")
         else:
             self.tier_out = "tcp"
-            tx = FanOutSender(socks, depth=self.tx_depth,
-                              codec=self.codec,
-                              gauge="node.tx_queue_depth",
-                              span=self._span_label,
-                              hist="node.tx_s")
+            if self.failover:
+                # seq-replay fan-out (docs/ROBUSTNESS.md): retain each
+                # frame until the downstream fan-in's cumulative ack,
+                # heal a dead replica channel by redialing its address
+                # (the chain supervisor respawns it on the same port)
+                # and replaying the unacked window
+                tx = ReplayFanOut(socks, self.next_hops,
+                                  depth=self.tx_depth,
+                                  codec=self.codec,
+                                  gauge="node.tx_queue_depth",
+                                  span=self._span_label,
+                                  hist="node.tx_s",
+                                  redial_timeout_s=connect_timeout_s)
+            else:
+                tx = FanOutSender(socks, depth=self.tx_depth,
+                                  codec=self.codec,
+                                  gauge="node.tx_queue_depth",
+                                  span=self._span_label,
+                                  hist="node.tx_s")
             tx.send_ctrl({"cmd": "stream_begin"})
         tx.sample_every = self.trace_sample_every
         self._live_tx = tx
@@ -617,6 +669,11 @@ class StageNode:
             reg = REGISTRY
             tx_live = self._live_tx
             cap = self._capacity()
+            from ..obs.events import recorder as _recorder
+            rec = _recorder()
+            _, evs = rec.events_since(
+                int(msg.get("event_cursor", 0)),
+                limit=int(msg.get("event_limit", 256)))
             send_ctrl(conn, {
                 "stage": None if m is None else m["index"],
                 "name": None if m is None else m["name"],
@@ -699,9 +756,73 @@ class StageNode:
                 "flops": self.stage_flops,
                 "mfu": cap.get("mfu"),
                 "achieved_flops_s": cap.get("achieved_flops_s"),
+                # seq-replay substrate (docs/ROBUSTNESS.md): channels
+                # healed, frames retained for replay, duplicates the
+                # fan-in absorbed inside its dedup window
+                "failovers": getattr(tx_live, "failovers", 0),
+                "replay_depth": (tx_live.replay_depth()
+                                 if hasattr(tx_live, "replay_depth")
+                                 else 0),
+                "merge_duplicates": (self._merge.duplicates
+                                     if self._merge is not None else 0),
+                # this process's flight-recorder tail (bounded; obs_push
+                # streams the same ring incrementally) — how a teardown-
+                # time stats sweep sees the failover/quiesce timeline
+                # without a live subscription
+                "events": {"dropped": rec.dropped, "events": evs},
             })
             return True
+        if cmd == "quiesce":
+            # drain to a stable sequence point (docs/ROBUSTNESS.md): the
+            # reply comes only once nothing is in flight on this node —
+            # the per-stage half of a live replan's safe cutover
+            at = msg.get("at_seq")
+            processed = self._quiesce(
+                None if at is None else int(at),
+                float(msg.get("timeout_s", 30.0)))
+            from ..obs.events import emit as emit_event
+            emit_event("quiesce", hop=self._span_label(),
+                       processed=processed)
+            send_ctrl(conn, {"cmd": "quiesced", "processed": processed})
+            return True
+        if cmd == "shutdown":
+            # a persistent node exits its serve loop; a one-shot node
+            # ACKs harmlessly (its serve returns at stream end anyway)
+            send_ack(conn)
+            if self._done_q is not None:
+                self._done_q.put(_SHUTDOWN)
+            return True
         raise ValueError(f"unknown control command {msg!r}")
+
+    def _quiesce(self, at_seq: int | None, timeout_s: float) -> int:
+        """Block until this node's data plane is drained and stable:
+        ``processed`` past ``at_seq`` (when given) and unchanged across
+        consecutive samples, no dispatch in flight, live queues and the
+        reorder merge empty.  Returns the stable processed count;
+        TimeoutError if the node never settles (frames still arriving —
+        the caller quiesced mid-segment instead of at a boundary)."""
+        deadline = time.monotonic() + timeout_s
+        inflight_g = REGISTRY.gauge("node.inflight")
+        last = -1
+        while True:
+            p = self.processed
+            rx, tx = self._live_rx, self._live_tx
+            merge = self._merge
+            idle = (
+                (at_seq is None or p >= at_seq)
+                and p == last
+                and inflight_g.value == 0
+                and (rx is None or rx.qsize() == 0)
+                and (tx is None or tx.qsize() == 0)
+                and (merge is None or merge.qsize() == 0))
+            if idle:
+                return p
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"quiesce: node did not stabilize within "
+                    f"{timeout_s:.1f}s (processed {p}, at_seq {at_seq})")
+            last = p
+            time.sleep(0.05)
 
     # -- live observability (obs_push payloads) -----------------------------
 
@@ -816,6 +937,10 @@ class StageNode:
                           if self._merge is not None
                           else self._join.qsize()
                           if self._join is not None else 0),
+                # retained-frame memory of a failover fan-out (the
+                # monitor's replay-window gauge, docs/ROBUSTNESS.md)
+                "replay": (tx.replay_depth()
+                           if hasattr(tx, "replay_depth") else 0),
             },
             "latency": {
                 # per-node / per-channel instruments where they exist
@@ -885,6 +1010,7 @@ class StageNode:
             finally:
                 conn.close()
 
+        total = 0
         self._srv.settimeout(0.25)
         try:
             while True:
@@ -899,9 +1025,16 @@ class StageNode:
                     r = done.get_nowait()
                 except _q.Empty:
                     continue
+                if r is _SHUTDOWN:
+                    return total
                 if isinstance(r, BaseException):
                     raise r
-                return r
+                if not self.persist:
+                    return r
+                # persistent node: the segment is done, keep serving
+                # until a shutdown control command (live replan's
+                # quiesce -> redeploy -> resume rides stream segments)
+                total += r
         finally:
             self._srv.close()
 
@@ -966,6 +1099,20 @@ class StageNode:
         # the gauge is bound once this connection proves to be the stream
         rx = AsyncReceiver(conn, depth=self.rx_depth,
                            span=self._span_label)
+        # replica half of the ack plane (docs/ROBUSTNESS.md): forward
+        # the downstream fan-in's cumulative replay_acks one hop
+        # upstream on this replica's own inbound connection; the lock
+        # serializes those writes against the stream-end replay_done
+        ack_lock = threading.Lock()
+        relay_on = [False]
+
+        def start_relay():
+            if relay_on[0] or not (self.failover
+                                   and self.replica is not None
+                                   and out_socks):
+                return
+            relay_on[0] = True
+            self._start_ack_relay(conn, out_socks[0], ack_lock)
 
         def drain_one():
             nonlocal n, streamed
@@ -1025,13 +1172,25 @@ class StageNode:
                             # announced themselves in _make_tx)
                             tx, out_socks = self._make_tx(
                                 connect_timeout_s)
+                            start_relay()
                             if not isinstance(
-                                    tx, (FanOutSender, BroadcastSender)) \
+                                    tx, (FanOutSender, BroadcastSender,
+                                         ReplayFanOut)) \
                                     and self.branch is None:
                                 tx.send_ctrl({"cmd": "stream_begin"})
                         # END + join: every relayed frame is on the wire
                         # before the finally block closes the socket
                         tx.close(timeout=connect_timeout_s)
+                        if relay_on[0]:
+                            # every frame of this replica's segment got
+                            # downstream: tell the upstream fan-out the
+                            # coming EOF is shutdown, not death
+                            try:
+                                with ack_lock:
+                                    send_ctrl(conn,
+                                              {"cmd": "replay_done"})
+                            except OSError:
+                                pass
                         from ..obs.events import emit as emit_event
                         emit_event("stream_end", hop=self._span_label(),
                                    n=n)
@@ -1078,6 +1237,7 @@ class StageNode:
                         if tx is None:
                             tx, out_socks = self._make_tx(
                                 connect_timeout_s)
+                            start_relay()
                         tx.send_ctrl(value)
                         continue
                     is_trace = (isinstance(value, dict)
@@ -1106,6 +1266,7 @@ class StageNode:
                         "--artifact or deploy in-band first)")
                 if tx is None:
                     tx, out_socks = self._make_tx(connect_timeout_s)
+                    start_relay()
                 if self._live_rx is not rx:
                     # first tensor on this channel (tx may already be
                     # open from a req_meta cascade): bind the live
@@ -1276,6 +1437,77 @@ class StageNode:
             if out is not None:
                 out.close()
 
+    # -- seq-replay ack plane (docs/ROBUSTNESS.md) ---------------------------
+
+    def _start_ack_relay(self, up_conn, down_sock, lock) -> None:
+        """Replica half of the ack plane: read the downstream fan-in's
+        cumulative ``replay_ack`` control frames off the data socket's
+        reverse direction and forward each one hop upstream on this
+        replica's own inbound connection — the fan-out's replay window
+        drains end to end without a dedicated ack port.  ``lock``
+        serializes the upstream writes against the stream-end
+        ``replay_done``; the thread dies silently with either socket."""
+
+        def relay():
+            try:
+                while True:
+                    kind, value = recv_frame(down_sock)
+                    if kind == K_END:
+                        return
+                    if kind == K_CTRL and isinstance(value, dict) \
+                            and value.get("cmd") == "replay_ack":
+                        with lock:
+                            send_ctrl(up_conn, value)
+            except (OSError, ConnectionError, ValueError):
+                return
+
+        threading.Thread(target=relay, daemon=True,
+                         name="node-ack-relay").start()
+
+    def _fanin_ack(self, merge) -> None:
+        """Fan-in half of the ack plane: one cumulative ``replay_ack``
+        (every seq below it merged in order) on each live upstream
+        connection.  A connection that fails the write is dropped from
+        the ack set — its reader thread notices the death itself."""
+        with self._merge_lock:
+            conns = list(self._fanin_conns or ())
+        upto = merge.next_seq
+        for c in conns:
+            try:
+                send_ctrl(c, {"cmd": "replay_ack", "seq": upto})
+            except OSError:
+                self._fanin_forget(c)
+
+    def _fanin_forget(self, conn) -> None:
+        with self._merge_lock:
+            if self._fanin_conns and conn in self._fanin_conns:
+                self._fanin_conns.remove(conn)
+
+    def _fanin_grace(self, merge, exc: BaseException) -> None:
+        """Poison ``merge`` with ``exc`` after the redial grace UNLESS
+        a fresh upstream registers in the meantime (the respawned
+        replica's dial-in bumps ``_fanin_epoch``) or the segment
+        completes — failover tolerance with a bounded hang."""
+        with self._merge_lock:
+            epoch = self._fanin_epoch
+
+        def watch():
+            deadline = time.monotonic() + self.failover_grace_s
+            while time.monotonic() < deadline:
+                with self._merge_lock:
+                    if self._fanin_epoch != epoch \
+                            or self._merge is not merge:
+                        return
+                time.sleep(0.1)
+            with self._merge_lock:
+                expired = (self._merge is merge
+                           and self._fanin_epoch == epoch)
+            if expired:
+                merge.fail(exc)
+
+        threading.Thread(target=watch, daemon=True,
+                         name="node-failover-grace").start()
+
     # -- fan-in (this node merges R replicated upstreams) --------------------
 
     def _serve_conn_fanin(self, conn, connect_timeout_s: float) -> None:
@@ -1287,12 +1519,14 @@ class StageNode:
         the merged compute loop (:meth:`_merge_compute`) is the one
         producer of the stream's tensor count."""
         registered = False
+        merge = None
         try:
             while True:
                 kind, value = recv_frame(conn)
                 if kind == K_END:
                     if registered:
-                        self._merge.end()
+                        self._fanin_forget(conn)
+                        merge.end()
                     return None
                 if kind == K_CTRL:
                     if isinstance(value, dict) \
@@ -1302,7 +1536,8 @@ class StageNode:
                         # merge's END bookkeeping
                         if not registered:
                             registered = True
-                            self._ensure_merge_loop(connect_timeout_s)
+                            merge = self._ensure_merge_loop(
+                                connect_timeout_s, conn=conn)
                         continue
                     if isinstance(value, dict) \
                             and value.get("cmd") == "tier_probe":
@@ -1321,7 +1556,7 @@ class StageNode:
                         # compute loop re-sends it (duplicates across
                         # the R paths are harmless — adoption is
                         # idempotent and the dispatcher skips them)
-                        self._merge.put_ctrl(dict(self._pending_trace))
+                        merge.put_ctrl(dict(self._pending_trace))
                     continue
                 if kind == K_TENSOR:
                     raise ValueError(
@@ -1333,9 +1568,10 @@ class StageNode:
                 seq, arr = value
                 if not registered:
                     registered = True
-                    self._ensure_merge_loop(connect_timeout_s)
+                    merge = self._ensure_merge_loop(connect_timeout_s,
+                                                    conn=conn)
                 t0 = time.perf_counter()
-                self._merge.put(seq, arr)
+                merge.put(seq, arr)
                 tr = tracer()
                 if tr.enabled:
                     tr.record(f"{self._span_label()}.merge_wait", t0,
@@ -1345,32 +1581,67 @@ class StageNode:
             # (and poisons the merge so the compute loop fails too); a
             # connection that never streamed is logged and dropped
             if registered:
-                self._merge.fail(e)
+                if self.failover and isinstance(e, (ConnectionError,
+                                                    OSError)):
+                    # a replica died mid-stream (docs/ROBUSTNESS.md
+                    # failover timeline): tolerate for one redial
+                    # grace — the healed fan-out replays the dead
+                    # path's unacked frames through the respawned
+                    # replica's NEW connection; only an unfilled grace
+                    # poisons the merge with the original error
+                    from ..obs.events import emit as emit_event
+                    emit_event("replica_lost", hop=self._span_label(),
+                               error=repr(e))
+                    self._fanin_forget(conn)
+                    self._fanin_grace(merge, e)
+                    return None
+                merge.fail(e)
                 raise
             print(f"node: dropped connection before streaming: {e!r}",
                   file=sys.stderr, flush=True)
             return None
 
-    def _ensure_merge_loop(self, connect_timeout_s: float) -> None:
+    def _ensure_merge_loop(self, connect_timeout_s: float,
+                           conn=None) -> FanInMerge:
         """Create the shared reorder merge and its single compute thread
-        the first time an upstream turns out to be a data path."""
+        the first time an upstream turns out to be a data path; under
+        failover, ``conn`` joins the ack set and bumps the registration
+        epoch (a respawned replica's dial-in cancels the grace timer).
+        Returns the segment's merge — readers hold it locally so a
+        persistent node's segment reset can't yank it mid-use."""
         with self._merge_lock:
-            if self._merge is not None:
-                return
-            # capacity: every upstream gets rx_depth frames of reorder
-            # slack before backpressure parks its reader thread
-            self._merge = FanInMerge(
-                self.fan_in,
-                capacity=max(self.fan_in, self.fan_in * self.rx_depth))
-            t = threading.Thread(
-                target=self._merge_loop, args=(connect_timeout_s,),
-                daemon=True, name="node-merge-compute")
-            t.start()
+            if self.failover and conn is not None:
+                if self._fanin_conns is None:
+                    self._fanin_conns = []
+                self._fanin_conns.append(conn)
+                self._fanin_epoch += 1
+            if self._merge is None:
+                # capacity: every upstream gets rx_depth frames of
+                # reorder slack before backpressure parks its reader
+                # thread; the dedup window absorbs failover replay
+                # overlaps (transport/replicate.py, docs/ROBUSTNESS.md)
+                self._merge = FanInMerge(
+                    self.fan_in,
+                    capacity=max(self.fan_in,
+                                 self.fan_in * self.rx_depth),
+                    replay_window=(_REPLAY_DEDUP_WINDOW
+                                   if self.failover else 0))
+                t = threading.Thread(
+                    target=self._merge_loop, args=(connect_timeout_s,),
+                    daemon=True, name="node-merge-compute")
+                t.start()
+            return self._merge
 
     def _merge_loop(self, connect_timeout_s: float) -> None:
         done = self._done_q
         try:
-            done.put(self._merge_compute(connect_timeout_s))
+            n = self._merge_compute(connect_timeout_s)
+            with self._merge_lock:
+                # segment complete: a persistent node's next stream
+                # builds a fresh merge (and a fresh ack set)
+                self._merge = None
+                self._fanin_conns = None
+            done.put(n)
         except BaseException as e:  # noqa: BLE001 — surfaced via serve()
             self._merge.fail(e)  # wake readers parked in put()
             done.put(e)
@@ -1415,20 +1686,27 @@ class StageNode:
             tx.send(y)
             n += 1
 
+        merge = self._merge
         try:
             while True:
                 if pending:
                     try:
-                        kind, value = self._merge.get_nowait()
+                        kind, value = merge.get_nowait()
                     except _q.Empty:
                         drain_one()
                         continue
                 else:
-                    kind, value = self._merge.get()
-                merge_g.v = self._merge.qsize()
+                    kind, value = merge.get()
+                merge_g.v = merge.qsize()
                 if kind == K_END:
                     while pending:
                         drain_one()
+                    if self.failover:
+                        # final cumulative ack: release the upstream
+                        # fan-out's whole retained window before the
+                        # END cascades (best effort — a replica that
+                        # already exited just misses one write)
+                        self._fanin_ack(merge)
                     if tx is None:
                         # all upstreams were zero-frame paths: still
                         # propagate the stream downstream (see the
@@ -1465,6 +1743,11 @@ class StageNode:
                 pending.append((t0, seq, self.prog(value)))
                 seq += 1
                 inflight_g.inc()
+                if self.failover and seq % ACK_EVERY == 0:
+                    # cumulative ack cadence: every merged seq below
+                    # merge.next_seq is in order here — the upstream
+                    # fan-out can release its retained frames
+                    self._fanin_ack(merge)
                 while len(pending) >= self.inflight:
                     drain_one()
         finally:
@@ -2403,8 +2686,66 @@ class ChainDispatcher:
                 s.close()
         return total
 
-    def close(self):
-        """Drain the chain (best effort) and close every socket.
+    def quiesce(self, node_addrs: Sequence, *,
+                at_seq: int | None = None,
+                timeout_s: float | None = None) -> list[int]:
+        """Drain every node to a stable sequence point (the live-replan
+        barrier, docs/ROBUSTNESS.md): per node, a ``quiesce`` control
+        round-trip that returns only once the node's queues are empty,
+        its in-flight window has drained, and its processed count has
+        stopped moving (optionally past ``at_seq``).  Returns each
+        node's processed count at the quiesce point.  Entries of
+        ``node_addrs`` may be replica lists — every replica is
+        quiesced."""
+        t = self.timeout_s if timeout_s is None else timeout_s
+        flat: list[str] = []
+        for a in node_addrs:
+            flat.extend([a] if isinstance(a, str) else list(a))
+        out: list[int] = []
+        for addr in flat:
+            s = _connect_retry(*_parse_hostport(addr), timeout_s=t)
+            try:
+                msg: dict = {"cmd": "quiesce", "timeout_s": t}
+                if at_seq is not None:
+                    msg["at_seq"] = int(at_seq)
+                send_ctrl(s, msg)
+                reply = recv_expect(s, K_CTRL)
+                if not isinstance(reply, dict) \
+                        or reply.get("cmd") != "quiesced":
+                    raise ConnectionError(
+                        f"node {addr} answered quiesce with {reply!r}")
+                out.append(int(reply.get("processed", 0)))
+                send_end(s)
+            finally:
+                s.close()
+        return out
+
+    def shutdown_nodes(self, node_addrs: Sequence) -> None:
+        """Ask persistent nodes (``--persist``) to exit their serve loop
+        after the current segment — the graceful half of a live-replan
+        teardown (kill-free, so replay buffers and shm segments unwind
+        cleanly)."""
+        flat: list[str] = []
+        for a in node_addrs:
+            flat.extend([a] if isinstance(a, str) else list(a))
+        for addr in flat:
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=self.timeout_s)
+            try:
+                send_ctrl(s, {"cmd": "shutdown"})
+                recv_expect(s, K_ACK)
+                send_end(s)
+            finally:
+                s.close()
+
+    def end_stream(self):
+        """Drain the current stream segment (best effort) and drop every
+        data-plane connection — but KEEP the result server listening, so
+        a follow-up :meth:`stream` opens a fresh segment against nodes
+        that persisted across it (``--persist``).  The wire sequence
+        counter is NOT reset: seq numbers stay continuous across
+        segments, which is what lets a live replan splice byte-identical
+        streams (docs/ROBUSTNESS.md).
 
         The graceful END handshake is wrapped so a chain that already died
         mid-stream can't mask the original failure with a secondary
@@ -2474,6 +2815,30 @@ class ChainDispatcher:
                 self._res_conn.close()
             for c in getattr(self, "_res_conns", None) or []:
                 c.close()
+            # reset to pre-connect state: the next stream() segment
+            # redials the (possibly re-deployed) chain from scratch
+            self._send_sock = None
+            self._send_socks = None
+            self._tx_chan = None
+            self._rx_chan = None
+            self._res_conn = None
+            self._res_conns = []
+            self._res_merge = None
+            # tier_out/tier_in stay readable (post-run reporting); the
+            # next segment's negotiation overwrites them
+            srv = getattr(self, "_res_srv", None)
+            if srv is not None:
+                try:
+                    srv.settimeout(self.timeout_s)
+                except OSError:
+                    pass  # already closed (end_stream after close)
+
+    def close(self):
+        """End the current segment (:meth:`end_stream`) and close the
+        result server — the dispatcher is done for good."""
+        try:
+            self.end_stream()
+        finally:
             self._res_srv.close()
 
 
@@ -2596,8 +2961,21 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               on_spawn=None,
               trace_sample_every: int = 0,
               plan=None, graph=None,
-              report_interval_ms: float = 250.0) -> list[np.ndarray]:
+              report_interval_ms: float = 250.0,
+              failover: bool = False) -> list[np.ndarray]:
     """Export, spawn one OS process per stage REPLICA, stream, tear down.
+
+    ``failover=True`` arms the seq-replay substrate
+    (docs/ROBUSTNESS.md): fan-out stages retain sent frames until the
+    downstream merge acks them, replicas relay acks upstream, and a
+    supervisor thread respawns any replica process that dies mid-stream
+    from its original argv — the healed channel redials, replays the
+    unacked window, and the fan-in dedups the overlap, so a ``kill -9``
+    of a mid-chain replica yields a byte-identical stream.  Requires
+    ``in_band=False`` (the respawn re-boots from command-line artifact
+    paths), at least one replicated stage, and every replicated stage
+    to be interior (a fan-out above it and a fan-in below it carry the
+    replay/ack plane).
 
     The one-call analogue of the reference's whole deployment procedure
     (start N ``node.py`` processes, run the dispatcher, src/dispatcher.py:
@@ -2707,6 +3085,24 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
             raise ValueError(
                 "replicas require the overlapped node loop "
                 "(drop overlap=False / --no-overlap)")
+        if failover:
+            if in_band:
+                raise ValueError(
+                    "failover requires in_band=False: the supervisor "
+                    "respawns a dead replica from its original argv, "
+                    "which must carry the artifact path")
+            if not any(r > 1 for r in r_of):
+                raise ValueError(
+                    "failover requires at least one replicated stage "
+                    "(replicas={k: R}) — an unreplicated stage's death "
+                    "has no surviving peer to absorb its slots")
+            for k in range(n):
+                if r_of[k] > 1 and not 0 < k < n - 1:
+                    raise ValueError(
+                        f"failover: replicated stage {k} must be "
+                        f"interior (0 < k < {n - 1}) — the replay/ack "
+                        f"plane needs a fan-out stage above it and a "
+                        f"fan-in stage below it")
         if hop_codecs is not None and len(hop_codecs) != n:
             raise ValueError(
                 f"hop_codecs must have one entry per stage "
@@ -2816,6 +3212,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                 child_env.get("XLA_FLAGS"), devices)
 
         tuning = [] if overlap else ["--no-overlap"]
+        if failover:
+            tuning += ["--failover"]
         for flag, v in (("--rx-depth", rx_depth), ("--tx-depth", tx_depth),
                         ("--inflight", inflight)):
             if v is not None:
@@ -2839,7 +3237,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
                     plan=plan, graph=graph,
                     report_interval_ms=report_interval_ms,
                     coloc=coloc, tier_of=tier_of, tier=tier,
-                    delay_of=delay_of, device_map=device_map)
+                    delay_of=delay_of, device_map=device_map,
+                    failover=failover)
             except _BindRace as e:
                 last_exc = e
                 print(f"run_chain: bind race on attempt {attempt + 1} "
@@ -2904,7 +3303,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                    rx_depth, tx_depth, stats_out, on_spawn,
                    trace_sample_every=0, plan=None, graph=None,
                    report_interval_ms=250.0, coloc=None, tier_of=None,
-                   tier="tcp", delay_of=None, device_map=None):
+                   tier="tcp", delay_of=None, device_map=None,
+                   failover=False):
     """One spawn -> deploy -> stream -> teardown attempt (see
     ``run_chain``).  Raises :class:`_BindRace` when a child died with an
     address-in-use failure; any other failure surfaces the dead node's
@@ -3054,7 +3454,54 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                 # obs_push stream for the duration of the stream
                 view = disp.watch(flat_addrs,
                                   interval_ms=report_interval_ms)
-            outs = disp.stream(inputs)
+            stop_super = threading.Event()
+            super_thread = None
+            if failover:
+                def _supervise():
+                    # respawn any dead REPLICA process from its original
+                    # argv (same listen port: SO_REUSEADDR lets the
+                    # respawn rebind immediately); the upstream replay
+                    # fan-out's redial loop bridges the gap and replays
+                    # the unacked window once the new process binds.
+                    # procs[idx] is REPLACED so the post-stream rc check
+                    # judges the respawn, not the corpse.
+                    from ..obs.events import emit as emit_event
+                    from ..transport.shm import sweep_orphan_segments
+                    while not stop_super.wait(0.2):
+                        for idx, unit in enumerate(units):
+                            rc = procs[idx].poll()
+                            if rc is None or rc == 0:
+                                continue
+                            if len(unit) != 1 or r_of[unit[0][0]] <= 1:
+                                return  # not respawnable: let teardown
+                                        # surface the death
+                            k, j = unit[0]
+                            # a kill -9 skipped every unlink path: reap
+                            # shm segments before the replacement boots
+                            sweep_orphan_segments()
+                            procs[idx] = subprocess.Popen(
+                                argv_for(unit), env=child_env,
+                                stdout=logs[idx],
+                                stderr=subprocess.STDOUT)
+                            emit_event("replica_respawn", stage=k,
+                                       replica=j, addr=addrs[k][j],
+                                       rc=rc)
+                            print(f"run_chain: respawned "
+                                  f"{stage_label(k, j)} (rc={rc})",
+                                  file=sys.stderr, flush=True)
+
+                super_thread = threading.Thread(
+                    target=_supervise, daemon=True,
+                    name="chain-supervisor")
+                super_thread.start()
+            try:
+                outs = disp.stream(inputs)
+            finally:
+                # stop BEFORE teardown: the END cascade exits every
+                # node, and exits must not read as deaths to respawn
+                stop_super.set()
+                if super_thread is not None:
+                    super_thread.join(timeout=5.0)
             if stats_out is not None:
                 # per-replica observability, queried while the nodes are
                 # still serving (they exit once close() cascades END)

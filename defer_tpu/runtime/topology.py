@@ -158,6 +158,69 @@ class ChainTopology:
                 raise ValueError(f"vertex {v.vid} has {indeg[v.vid]} "
                                  f"upstreams but join={v.join}")
 
+    # -- mutation (live replan, docs/ROBUSTNESS.md) -------------------------
+
+    def update(self, vid: int, **changes) -> TopoVertex:
+        """Mutate one vertex in place (``dataclasses.replace`` on the
+        frozen vertex, swapped into the list) and revalidate the whole
+        graph.  A change that breaks an invariant is ROLLED BACK before
+        the ``ValueError`` propagates — a topology object is never left
+        observably invalid, because a live replan hands it straight to
+        ``deploy_topology``."""
+        if not 0 <= vid < len(self.vertices):
+            raise ValueError(f"no vertex {vid} in {self!r}")
+        old = self.vertices[vid]
+        new = dataclasses.replace(old, **changes)
+        self.vertices[vid] = new
+        try:
+            self.validate()
+        except ValueError:
+            self.vertices[vid] = old
+            raise
+        return new
+
+    def move_boundary(self, vid: int, *, nodes, output: str,
+                      downstream_nodes, downstream_inputs) -> None:
+        """Shift the cut between vertex ``vid`` and ``vid + 1``: the
+        upstream vertex now evaluates ``nodes`` and emits ``output``;
+        the downstream evaluates ``downstream_nodes`` seeded by
+        ``downstream_inputs``.  This is the replanner's one move —
+        migrating layer-graph nodes across an adjacent boundary —
+        expressed as a single atomic topology edit."""
+        if vid + 1 >= len(self.vertices):
+            raise ValueError(f"vertex {vid} has no downstream boundary")
+        up_old, dn_old = self.vertices[vid], self.vertices[vid + 1]
+        self.vertices[vid] = dataclasses.replace(
+            up_old, nodes=tuple(nodes), output=output)
+        self.vertices[vid + 1] = dataclasses.replace(
+            dn_old, nodes=tuple(downstream_nodes),
+            inputs=tuple(downstream_inputs))
+        try:
+            self.validate()
+        except ValueError:
+            self.vertices[vid] = up_old
+            self.vertices[vid + 1] = dn_old
+            raise
+
+    def diff(self, other: "ChainTopology") -> dict:
+        """Structural delta ``self -> other``: which vertex ids changed,
+        appeared, or vanished.  A live replan redeploys EXACTLY
+        ``changed + added`` — untouched stages keep their loaded
+        artifact across the cutover."""
+        mine = {v.vid: v.to_json() for v in self.vertices}
+        theirs = {v.vid: v.to_json() for v in other.vertices}
+        return {
+            "changed": sorted(vid for vid in mine.keys() & theirs.keys()
+                              if mine[vid] != theirs[vid]),
+            "added": sorted(theirs.keys() - mine.keys()),
+            "removed": sorted(mine.keys() - theirs.keys()),
+        }
+
+    def copy(self) -> "ChainTopology":
+        """Deep-enough copy: vertices are frozen, the list is fresh —
+        mutate the copy, diff against the original."""
+        return ChainTopology(list(self.vertices))
+
     # -- (de)serialization --------------------------------------------------
 
     def to_json(self) -> dict:

@@ -77,7 +77,7 @@ class _Unit:
 
     __slots__ = ("client", "seq", "rid", "sample", "queued_at",
                  "queued_pc", "popped_at", "submitted_at", "demuxed_at",
-                 "sampled_seq")
+                 "sampled_seq", "settled")
 
     def __init__(self, client: _Client, seq: int, rid: int,
                  sample: np.ndarray):
@@ -85,6 +85,11 @@ class _Unit:
         self.seq = seq          #: the client's own sample number
         self.rid = rid          #: door-global request id (demux key)
         self.sample = sample
+        #: admission-slot settlement token (guarded by client.state):
+        #: delivery and the backend-lost shed sweep can both reach a
+        #: unit — whichever flips this settles the slot, the other
+        #: backs off
+        self.settled = False
         self.queued_at = time.monotonic()
         #: the same instant on the tracer/attribution clock
         #: (perf_counter) — plus the downstream waypoints the batch
@@ -266,13 +271,33 @@ class ChainBackend:
             if not self._halt.is_set():
                 self.error = e
 
+    def halt_demux(self) -> None:
+        """Stop the demux reader and wait it out — the backend-lost
+        settlement sweep must not race a late delivery for the same
+        admission slot."""
+        self._halt.set()
+        if self._rx is not None:
+            self._rx.join(timeout=10.0)
+
+    def drain_pending(self) -> list[_Unit]:
+        """Pop every in-flight unit (submitted into the chain, result
+        never demuxed) and release their window slots.  Call with the
+        demux halted; the units' admission slots are the caller's to
+        settle."""
+        with self._lock:
+            frames = list(self._pending.values())
+            self._pending.clear()
+            self._metas.clear()
+        units = [u for frame in frames for u in frame.values()]
+        for _ in frames:
+            self._window.release()
+        return units
+
     def close(self) -> None:
         # stop the demux reader BEFORE the dispatcher's drain: both read
         # the result channel, and a demux thread still racing would eat
         # the cascaded K_END and leave close() waiting out its timeout
-        self._halt.set()
-        if self._rx is not None:
-            self._rx.join(timeout=10.0)
+        self.halt_demux()
         try:
             self.disp.close()
         except Exception:  # noqa: BLE001 — teardown best-effort
@@ -323,6 +348,10 @@ class ServeFrontDoor:
         self._next_rid = 0
         self._engine_loop: EngineLoop | None = None
         self.error: BaseException | None = None
+        #: set once the chain backend died and its in-flight units were
+        #: shed/settled — the door then sheds new samples at ingest
+        #: (reason "backend_lost") instead of queueing into a dead chain
+        self._backend_dead = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -370,11 +399,19 @@ class ServeFrontDoor:
             self._finish_client(c, send_eos=False)
 
     def healthcheck(self) -> None:
-        """Raise the first backend/loop error (tests poll this)."""
-        for src in (self, self.backend, self._engine_loop):
+        """Raise the first UNHANDLED backend/loop error (tests poll
+        this).  A chain-backend death the form loop already settled
+        (every affected tenant shed with ``retry_after_ms``, slots
+        released — :meth:`_backend_lost`) is degraded-but-honest
+        service, not a health failure: the door keeps answering, and
+        ``stats()['pressure']['backend_lost']`` carries the state."""
+        for src in (self, self._engine_loop):
             err = getattr(src, "error", None)
             if err is not None:
                 raise err
+        if self.backend is not None and self.backend.error is not None \
+                and not self._backend_dead:
+            raise self.backend.error
 
     # -- tenant connections ------------------------------------------------
 
@@ -488,6 +525,19 @@ class ServeFrontDoor:
                 unit: Any = self._make_decode_request(client, seq, rid,
                                                       value)
             else:
+                if self._backend_dead:
+                    # the chain is gone: shed at ingest with the same
+                    # retry contract the settlement sweep used — never
+                    # admit into a queue nothing drains
+                    with client.wlock:
+                        send_ctrl(client.conn, {
+                            "cmd": "shed", "seq": seq, "admitted": False,
+                            "predicted_ms": 0.0, "reason": "backend_lost",
+                            "retry_after_ms": round(max(
+                                0.05, self.admission.service_estimate_s())
+                                * 1e3, 3)})
+                    seq += 1
+                    continue
                 sample = np.asarray(value, np.float32)
                 if sample.shape != self.backend.in_shape:
                     sample = sample.reshape(self.backend.in_shape)
@@ -546,10 +596,16 @@ class ServeFrontDoor:
         """Tensor-mode result: route one row back to its owner (row is
         None when the unit was dropped with its dead client)."""
         client = unit.client
-        self.admission.complete(client.tenant, queued_at=unit.queued_at)
         with client.state:
+            # settle exactly once: the backend-lost sweep and a late
+            # delivery can both reach a unit — the settled flag is the
+            # ownership token (the decode path's client.requests twin)
+            if unit.settled:
+                return
+            unit.settled = True
             client.outstanding -= 1
             alive = client.alive
+        self.admission.complete(client.tenant, queued_at=unit.queued_at)
         if row is not None and alive:
             try:
                 with client.wlock:
@@ -678,17 +734,112 @@ class ServeFrontDoor:
         try:
             while not self._halt.is_set():
                 entries = self.former.form(timeout=0.25)
-                if entries:
-                    self.backend.submit(entries)
+                err = self.backend.error
+                if err is None and entries:
+                    try:
+                        self.backend.submit(entries)
+                        entries = []
+                    except BaseException as e:  # noqa: BLE001
+                        # a dead chain surfaces as a send failure here
+                        # before the demux notices EOF; either way the
+                        # settlement sweep below owns the cleanup
+                        err = e
+                if err is not None:
+                    if not self._halt.is_set():
+                        self._backend_lost(err, entries)
+                    return
                 self.healthcheck()
         except BaseException as e:  # noqa: BLE001
             if not self._halt.is_set():
                 self.error = e
 
+    def _backend_lost(self, err: BaseException,
+                      entries: list[tuple[str, _Unit]]) -> None:
+        """The chain backend died mid-request: settle EVERY affected
+        admission slot exactly once and shed the owning tenants with a
+        ``retry_after_ms`` hint, instead of failing the healthcheck and
+        leaving in-flight clients hanging (docs/ROBUSTNESS.md).
+
+        Affected units live in three mutually exclusive places —
+        formed-but-unsubmitted (``entries``), submitted into the dead
+        chain (the backend's pending frames), and still queued in
+        admission; the per-unit ``settled`` token makes the sweep safe
+        against any delivery that raced the demux shutdown."""
+        # stop late deliveries FIRST: settlement must not race the demux
+        self.backend.halt_demux()
+        self._backend_dead = True
+        units = [u for _, u in entries]
+        units += self.backend.drain_pending()
+        while True:
+            nxt = self.admission.queue.pop(timeout=0.0)
+            if nxt is None:
+                break
+            units.append(nxt[1])
+        # one honest retry hint for the whole incident: the time to
+        # redeploy a chain dwarfs per-unit service, so hint the larger
+        retry_s = max(0.05, self.admission.service_estimate_s()
+                      * max(1, len(units)))
+        shed = 0
+        for u in units:
+            if self._shed_unit(u, retry_s):
+                shed += 1
+        emit_event("backend_lost", error=type(err).__name__, shed=shed)
+
+    def _shed_unit(self, unit: _Unit, retry_s: float) -> bool:
+        """Settle one in-flight unit as shed (backend lost): release its
+        admission slot, tell its client to retry.  Returns False when a
+        racing delivery already settled it."""
+        client = unit.client
+        with client.state:
+            if unit.settled:
+                return False
+            unit.settled = True
+            client.outstanding -= 1
+            alive = client.alive
+        self.admission.complete(client.tenant, queued_at=unit.queued_at)
+        REGISTRY.counter(f"serve.tenant.{client.tenant}.shed").n += 1
+        REGISTRY.counter("serve.shed").n += 1
+        if alive:
+            try:
+                with client.wlock:
+                    send_ctrl(client.conn, {
+                        "cmd": "shed", "seq": unit.seq, "admitted": False,
+                        "predicted_ms": 0.0, "reason": "backend_lost",
+                        "retry_after_ms": round(retry_s * 1e3, 3)})
+            except OSError as e:
+                self._disconnect(client, e)
+                return True
+        self._maybe_drained(client)
+        return True
+
     # -- observability -----------------------------------------------------
+
+    def pressure(self) -> dict:
+        """Admission-pressure snapshot: the serving-side input to the
+        replanner's scale decision (docs/ROBUSTNESS.md).  A monitor loop
+        combines ``drain_eta_ms`` (how long the current backlog takes at
+        the live service estimate) with the straggler detector's
+        :meth:`~defer_tpu.obs.cluster.StragglerDetector.suggest` — a
+        bursty arrival trace shows up here as backlog long before it
+        shows up in any per-stage latency histogram, which is what lets
+        queue depth drive a cutover instead of merely describing one."""
+        queued = self.admission.queue.qsize()
+        inflight = self.admission.inflight
+        unit_s = self.admission.service_estimate_s()
+        return {
+            "queued": queued,
+            "inflight": inflight,
+            # frames of work outstanding at the deployed width
+            "backlog_frames": -(-inflight // max(1, self.width)),
+            "drain_eta_ms": round(inflight * unit_s * 1e3, 3),
+            "service_estimate_ms": round(unit_s * 1e3, 4),
+            "width": self.width,
+            "backend_lost": self._backend_dead,
+        }
 
     def stats(self) -> dict:
         doc = {"mode": self.mode, "width": self.width,
+               "pressure": self.pressure(),
                "frames": REGISTRY.counter("serve.frames").value,
                "samples": REGISTRY.counter("serve.samples").value,
                # per-tenant latency-attribution buckets (ms summaries)

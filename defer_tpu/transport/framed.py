@@ -97,24 +97,46 @@ def configure_socket(sock: socket.socket, *, nodelay: bool = True,
     return sock
 
 
-def connect_retry(host: str, port: int, timeout_s: float = 30.0
-                  ) -> socket.socket:
-    """Connect to a peer that may still be booting: exponential-backoff
-    retry (50 ms doubling to 1 s) until ``timeout_s``, returning a
-    :func:`configure_socket`-tuned connection.  The one retry policy for
-    every control/data dial in the chain (stage nodes, dispatcher,
-    monitor subscriptions)."""
+def connect_retry(host: str, port: int, timeout_s: float = 30.0,
+                  *, base_delay_s: float = 0.05,
+                  max_delay_s: float = 1.0) -> socket.socket:
+    """Connect to a peer that may still be booting: exponential backoff
+    with full jitter (50 ms envelope doubling to 1 s) capped by the
+    ``timeout_s`` deadline, returning a :func:`configure_socket`-tuned
+    connection.  The one retry policy for every control/data dial in
+    the chain (stage nodes, dispatcher, monitor subscriptions, failover
+    re-dials).  Jitter matters on the failover path: R replica channels
+    re-dialing a respawned process on a fixed cadence would arrive in
+    lockstep bursts.  Every failed attempt emits a ``redial`` flight-
+    recorder event, so ``monitor --events`` attributes exactly how a
+    failover re-dial converged (docs/ROBUSTNESS.md)."""
+    import random
+
     deadline = time.monotonic() + timeout_s
-    delay = 0.05
+    envelope = base_delay_s
+    attempt = 0
     while True:
         try:
+            # per-attempt connect timeout is bounded by the remaining
+            # deadline, so the LAST attempt cannot overshoot the cap
+            budget = max(0.001, min(timeout_s,
+                                    deadline - time.monotonic()))
             return configure_socket(
-                socket.create_connection((host, port), timeout=timeout_s))
-        except OSError:
-            if time.monotonic() >= deadline:
+                socket.create_connection((host, port), timeout=budget))
+        except OSError as e:
+            attempt += 1
+            now = time.monotonic()
+            if now >= deadline:
                 raise
+            # full jitter: uniform over the exponential envelope,
+            # clipped to what the deadline still allows
+            delay = min(random.uniform(0.0, envelope), deadline - now)
+            from ..obs.events import emit as _emit
+            _emit("redial", addr=f"{host}:{port}", attempt=attempt,
+                  delay_ms=round(delay * 1e3, 3),
+                  error=type(e).__name__)
             time.sleep(delay)
-            delay = min(delay * 2, 1.0)
+            envelope = min(envelope * 2, max_delay_s)
 
 #: frame kinds
 K_TENSOR = 1

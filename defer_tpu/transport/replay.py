@@ -1,0 +1,546 @@
+"""Seq-replay substrate: bounded retain-until-ack + self-healing fan-out.
+
+The ordered fan machinery (``transport/replicate.py``) gives every frame
+an exact stream position — the v2 ``K_TENSOR_SEQ`` stamp the fan-out
+assigns and the fan-in merge releases in order.  This module adds the
+one mechanism both halves of the robustness story stand on
+(docs/ROBUSTNESS.md):
+
+* :class:`ReplayBuffer` — a bounded window of sent-but-unacked frames,
+  keyed by wire seq.  ``retain`` blocks when the window is full (the
+  retained-frame memory is the backpressure bound), a cumulative
+  ``ack(upto)`` releases everything below it, and ``unacked`` snapshots
+  what a healed channel must replay.
+* :class:`ReplayFanOut` — the :class:`~.replicate.FanOutSender` surface
+  with per-channel ack-reader threads and a heal path: when a replica
+  channel dies (send failure or ack-socket EOF), the channel re-dials
+  the SAME address with :func:`~.framed.connect_retry` (the respawned
+  replica binds its old port), re-sends the stream preamble
+  (``stream_begin`` / ``trace``), replays the channel's unacked window
+  in order, and resumes — emitting one ``failover`` flight-recorder
+  event with the measured recovery time.  Replayed frames that the
+  downstream fan-in already merged are deduped silently inside its
+  replay window (``FanInMerge(replay_window=...)``), so a replay
+  overlap can never corrupt or reorder the stream.
+
+The ack protocol rides the reverse direction of the fan-path data
+sockets — free by design, because fan paths always refuse tier offers
+(no shm doorbell shares the socket) and replica dial-backs never probe:
+
+* the fan-in's merge loop sends cumulative ``{"cmd": "replay_ack",
+  "seq": N}`` control frames upstream on every fan-in connection
+  (all frames below N are merged in order);
+* each replica relays the ack one hop further upstream on its own
+  inbound connection;
+* the fan-out's ack readers release the replay window, and a
+  ``{"cmd": "replay_done"}`` from a replica that completed its stream
+  cleanly marks that channel's later EOF as shutdown, not death.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Sequence
+
+from ..obs import REGISTRY, LatencyHistogram
+from .channel import AsyncSender, ChannelError
+from .framed import K_CTRL, K_END, connect_retry, recv_frame
+
+__all__ = ["ReplayBuffer", "ReplayFanOut", "ACK_EVERY"]
+
+#: fan-in ack cadence: one cumulative replay_ack per ACK_EVERY merged
+#: frames (plus one on stream end).  Small enough that the retained
+#: window stays shallow, large enough that acks never dominate the
+#: reverse path.
+ACK_EVERY = 8
+
+
+class ReplayBuffer:
+    """Bounded window of sent-but-unacked frames, keyed by wire seq.
+
+    One producer calls :meth:`retain` before each send; ack-reader
+    threads call :meth:`ack` with the downstream's cumulative merge
+    position; a healing channel snapshots :meth:`unacked`.  ``retain``
+    blocks while the window is full — retained-frame memory is the
+    failover mechanism's backpressure bound, published as a gauge so
+    the monitor can watch it (``gauge=`` name, absolute value).
+    """
+
+    def __init__(self, capacity: int = 256, *, gauge: str | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._frames: dict[int, object] = {}
+        self._acked = 0            # every seq < _acked is released
+        self._err: BaseException | None = None
+        self._cv = threading.Condition()
+        self._gauge = REGISTRY.gauge(gauge) if gauge else None
+        #: lifetime high watermark of retained frames
+        self.hi = 0
+
+    def retain(self, seq: int, value, timeout: float | None = None) -> None:
+        """Hold one frame until a cumulative ack releases it; blocks
+        while the window is full (an already-acked seq is a no-op)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if self._err is not None:
+                    raise self._err
+                if seq < self._acked:
+                    return
+                if len(self._frames) < self.capacity \
+                        or seq in self._frames:
+                    self._frames[seq] = value
+                    if len(self._frames) > self.hi:
+                        self.hi = len(self._frames)
+                    if self._gauge is not None:
+                        self._gauge.v = len(self._frames)
+                    return
+                if deadline is not None \
+                        and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replay window full ({self.capacity}) for "
+                        f"{timeout:.1f}s — no ack from downstream")
+                self._cv.wait(0.05)
+
+    def ack(self, upto: int) -> None:
+        """Cumulative release: drop every retained seq below ``upto``
+        (all of them merged in order downstream).  Stale acks are
+        no-ops — acks may arrive out of order across R relay paths."""
+        with self._cv:
+            if upto <= self._acked:
+                return
+            self._acked = upto
+            for s in [s for s in self._frames if s < upto]:
+                del self._frames[s]
+            if self._gauge is not None:
+                self._gauge.v = len(self._frames)
+            self._cv.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        """Wake a producer parked in :meth:`retain` with ``exc`` — a
+        channel heal that hard-failed must not leave the stream hung."""
+        with self._cv:
+            if self._err is None:
+                self._err = exc
+            self._cv.notify_all()
+
+    def unacked(self) -> list[tuple[int, object]]:
+        """Snapshot of retained (seq, frame) pairs in seq order — what
+        a healed channel replays (filtered to its own seq residues)."""
+        with self._cv:
+            return sorted(self._frames.items())
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._frames)
+
+    @property
+    def acked(self) -> int:
+        with self._cv:
+            return self._acked
+
+
+class ReplayFanOut:
+    """Round-robin replica fan-out that survives replica death.
+
+    Presents the :class:`~.replicate.FanOutSender` surface (``send`` /
+    ``send_ctrl`` / ``send_end`` / ``close`` / ``flush`` / ``qsize``
+    and the telemetry properties) over R :class:`AsyncSender` channels,
+    with three additions:
+
+    * every tensor frame is retained in a shared :class:`ReplayBuffer`
+      until the downstream fan-in's cumulative ``replay_ack`` releases
+      it (ack-reader thread per channel on the data socket's reverse
+      direction);
+    * a dead channel — send failure, or ack-reader EOF without a
+      ``replay_done`` — HEALS: re-dial the same address (the supervisor
+      respawns replicas on their old ports), re-send the recorded
+      stream preamble, replay the channel's unacked frames in order,
+      and resume.  Channel assignment stays ``seq % R`` throughout, so
+      a replayed frame always lands on the path whose fan-in slots it;
+    * recovery is measured and emitted as one ``failover`` event.
+
+    A replay can overlap frames the fan-in already merged (acks lag by
+    up to ``ACK_EVERY``); the fan-in's merge dedups those silently
+    inside its replay window.  Duplicate-tolerant downstream + replay-
+    until-acked upstream is the whole failover contract.
+    """
+
+    def __init__(self, socks: Sequence, addrs: Sequence[tuple[str, int]],
+                 *, depth: int = 8, codec: str = "raw",
+                 gauge: str | None = None, span=None,
+                 hist: str | None = None, window: int = 256,
+                 redial_timeout_s: float = 30.0,
+                 replay_gauge: str | None = "node.replay_depth"):
+        if not socks:
+            raise ValueError("ReplayFanOut needs at least one socket")
+        if len(socks) != len(addrs):
+            raise ValueError(f"{len(socks)} sockets but {len(addrs)} "
+                             f"addresses")
+        self._socks = list(socks)
+        self._addrs = [tuple(a) for a in addrs]
+        self.depth = depth
+        self._codec = codec
+        self._gauge_name = gauge
+        self._span = span
+        self._hist_name = hist
+        self.redial_timeout_s = redial_timeout_s
+        self._buf = ReplayBuffer(window, gauge=replay_gauge)
+        self._chans = [self._new_chan(s) for s in self._socks]
+        self._n = 0
+        self._cv = threading.Condition()
+        self._healing = [False] * len(self._chans)
+        self._chan_err: list[BaseException | None] = \
+            [None] * len(self._chans)
+        #: END queued on the CURRENT channel object of slot i
+        self._end_sent = [False] * len(self._chans)
+        self._end_queued = False
+        #: channel completed its stream cleanly (replay_done received):
+        #: a later EOF there is shutdown, not death
+        self._done = [False] * len(self._chans)
+        self._closing = False
+        #: heals performed (stats/obs: the failure-visibility counter)
+        self.failovers = 0
+        #: preamble ctrl frames a healed channel must re-send before
+        #: replaying data (stream_begin / trace), latest per cmd
+        self._preamble: list[dict] = []
+        for i, s in enumerate(self._socks):
+            self._start_ack_reader(i, s, self._chans[i])
+
+    def _new_chan(self, sock) -> AsyncSender:
+        return AsyncSender(sock, depth=self.depth, codec=self._codec,
+                           gauge=self._gauge_name, span=self._span,
+                           hist=self._hist_name)
+
+    def _start_ack_reader(self, i: int, sock, chan) -> None:
+        # each reader is bound to the channel GENERATION it was started
+        # for: after a heal swaps the slot, the stale reader must never
+        # act on the replacement (see _ack_loop's heal call)
+        threading.Thread(target=self._ack_loop, args=(i, sock, chan),
+                         daemon=True, name=f"replay-ack-{i}").start()
+
+    # -- FanOutSender telemetry surface --------------------------------------
+
+    @property
+    def width(self) -> int:
+        return len(self._chans)
+
+    @property
+    def sample_every(self) -> int:
+        return self._chans[0].sample_every
+
+    @sample_every.setter
+    def sample_every(self, n: int) -> None:
+        for ch in self._chans:
+            ch.sample_every = n
+
+    def take_watermark(self) -> int:
+        return max(ch.take_watermark() for ch in self._chans)
+
+    @property
+    def hi(self) -> int:
+        return max(ch.hi for ch in self._chans)
+
+    @property
+    def enc(self) -> LatencyHistogram:
+        h = LatencyHistogram()
+        for ch in self._chans:
+            h.merge(ch.enc)
+        return h
+
+    def qsize(self) -> int:
+        return sum(ch.qsize() for ch in self._chans)
+
+    def replay_depth(self) -> int:
+        """Frames currently retained for replay (the monitor gauge's
+        pull twin)."""
+        return self._buf.depth()
+
+    # -- ack plane -----------------------------------------------------------
+
+    def _ack_loop(self, i: int, sock, chan) -> None:
+        """Read the channel's reverse direction: cumulative replay_acks
+        release the window, replay_done marks a clean stream end, EOF
+        without one triggers the heal."""
+        try:
+            while True:
+                try:
+                    kind, value = recv_frame(sock)
+                except TimeoutError:
+                    # an IDLE reverse path is not a death: the first
+                    # ack only flows once the downstream fan-in merges
+                    # frames (a cold-boot compile can hold it for tens
+                    # of seconds), and the fan sockets carry a recv
+                    # timeout.  Death announces itself as EOF, reset,
+                    # or garbage — keep waiting through silence.
+                    if self._closing or self._done[i]:
+                        return
+                    continue
+                if kind == K_CTRL and isinstance(value, dict):
+                    cmd = value.get("cmd")
+                    if cmd == "replay_ack":
+                        self._buf.ack(int(value.get("seq", 0)))
+                    elif cmd == "replay_done":
+                        self._done[i] = True
+                elif kind == K_END:
+                    break
+        except (OSError, ConnectionError, ValueError):
+            pass
+        try:
+            if self._closing or self._done[i]:
+                return
+            try:
+                # heal THIS reader's channel generation, never the
+                # current slot occupant: a send-path heal may already
+                # have swapped in a healthy replacement, and _heal's
+                # identity check then turns this call into a no-op
+                # (healing the replacement would close a live replica's
+                # socket — the exact cascade this guards against)
+                self._heal(i, chan)
+            except BaseException:  # noqa: BLE001 — recorded in
+                pass               # _chan_err; surfaced on next send
+        finally:
+            # the reader owns its socket's close: _heal only shut the
+            # socket down (waking this recv with EOF), because closing
+            # an fd another thread is blocked in recv(2) on invites
+            # fd-reuse corruption — the freed number is recycled by the
+            # very next connect and the stale reader steals its bytes
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- heal ----------------------------------------------------------------
+
+    def _heal(self, i: int, dead) -> None:
+        """Replace channel ``i``: close the dead socket, re-dial the
+        same address, re-send the preamble, replay the channel's
+        unacked frames in order, swap in, measure and emit.  Exactly
+        one healer per (slot, dead channel); concurrent detectors wait
+        for its outcome."""
+        with self._cv:
+            while self._healing[i]:
+                self._cv.wait(0.05)
+            if self._chans[i] is not dead:
+                # someone else already healed this very death
+                if self._chan_err[i] is not None:
+                    raise ChannelError(
+                        f"replica channel {i} unrecoverable") \
+                        from self._chan_err[i]
+                return
+            if self._closing:
+                raise ChannelError(
+                    f"replica channel {i} died during teardown")
+            self._healing[i] = True
+            ended = self._end_queued
+        t0 = time.perf_counter()
+        host, port = self._addrs[i]
+        deadline = time.monotonic() + self.redial_timeout_s
+        try:
+            try:
+                # shutdown, NOT close: the slot's ack reader may be
+                # blocked in recv(2) on this fd — shutdown wakes it
+                # with EOF while the fd number stays reserved until
+                # the reader closes it itself (fd-reuse safety)
+                self._socks[i].shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            attempt = 0
+            while True:
+                # the whole connect + preamble + replay is ONE retryable
+                # attempt: a re-dial can land in the DYING process's
+                # listen backlog (its established-conn RSTs race its
+                # listener teardown), which accepts the connect and then
+                # resets mid-replay — only the next dial reaches the
+                # respawned replica
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    raise ChannelError(
+                        f"replica channel {i} ({host}:{port}): no "
+                        f"successful replay within "
+                        f"{self.redial_timeout_s:.1f}s")
+                sock = connect_retry(host, port, timeout_s=budget)
+                ch = self._new_chan(sock)
+                ch.sample_every = dead.sample_every
+                with self._cv:
+                    preamble = list(self._preamble)
+                try:
+                    for msg in preamble:
+                        ch.send_ctrl(msg)
+                    replayed = 0
+                    for s, arr in self._buf.unacked():
+                        if s % len(self._chans) == i:
+                            ch.send(arr, seq=s)
+                            replayed += 1
+                    if ended:
+                        # the old channel's END died with it: the healed
+                        # stream still has to terminate
+                        ch.send_end()
+                    break
+                except (ChannelError, OSError, ConnectionError,
+                        TimeoutError) as e:
+                    attempt += 1
+                    from ..obs.events import emit as _emit
+                    _emit("redial", addr=f"{host}:{port}",
+                          attempt=attempt, delay_ms=0.0,
+                          error=type(e).__name__)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            with self._cv:
+                self._socks[i] = sock
+                self._chans[i] = ch
+                self._chan_err[i] = None
+                self._end_sent[i] = ended
+                self._healing[i] = False
+                self.failovers += 1
+                self._cv.notify_all()
+            recovery_ms = (time.perf_counter() - t0) * 1e3
+            from ..obs.events import emit as _emit
+            _emit("failover",
+                  hop=(self._span() if callable(self._span)
+                       else self._span),
+                  chan=i, addr=f"{host}:{port}", replayed=replayed,
+                  recovery_ms=round(recovery_ms, 3))
+            REGISTRY.counter("transport.failovers").n += 1
+            self._start_ack_reader(i, sock, ch)
+        except BaseException as e:  # noqa: BLE001 — surfaced to sender
+            with self._cv:
+                self._chan_err[i] = e
+                self._healing[i] = False
+                self._cv.notify_all()
+            self._buf.fail(e if isinstance(e, ChannelError) else
+                           ChannelError(f"replica channel {i} "
+                                        f"({host}:{port}) could not be "
+                                        f"healed: {e!r}"))
+            raise
+
+    def _current_chan(self, i: int) -> AsyncSender:
+        """The live channel for slot ``i``, waiting out an in-flight
+        heal; raises the slot's terminal error if healing failed."""
+        with self._cv:
+            while self._healing[i]:
+                self._cv.wait(0.05)
+            if self._chan_err[i] is not None:
+                raise ChannelError(
+                    f"replica channel {i} unrecoverable") \
+                    from self._chan_err[i]
+            return self._chans[i]
+
+    # -- sender surface ------------------------------------------------------
+
+    def send(self, arr, *, seq: int | None = None) -> None:
+        """Retain, then round-robin like FanOutSender (tensor ``i`` to
+        channel ``i % R`` stamped ``seq=i``; a caller-supplied seq is
+        ignored — the fan-out owns its sequence segment).  A send that
+        hits a dead channel heals it and retries; the retry can
+        duplicate a frame the heal already replayed, which the
+        downstream merge dedups inside its replay window."""
+        s = self._n
+        self._n += 1
+        self._buf.retain(s, arr)
+        i = s % len(self._chans)
+        while True:
+            ch = self._current_chan(i)
+            try:
+                ch.send(arr, seq=s)
+                return
+            except ChannelError:
+                self._heal(i, ch)
+
+    def send_ctrl(self, msg: dict) -> None:
+        """Broadcast a control frame; stream-preamble commands
+        (``stream_begin`` / ``trace``) are recorded so a healed channel
+        can replay them ahead of its data."""
+        if isinstance(msg, dict) and msg.get("cmd") in ("stream_begin",
+                                                        "trace"):
+            with self._cv:
+                self._preamble = [m for m in self._preamble
+                                  if m.get("cmd") != msg.get("cmd")]
+                self._preamble.append(dict(msg))
+        for i in range(len(self._chans)):
+            while True:
+                ch = self._current_chan(i)
+                try:
+                    ch.send_ctrl(msg)
+                    break
+                except ChannelError:
+                    self._heal(i, ch)
+
+    def send_end(self) -> None:
+        self._end_queued = True
+        for i in range(len(self._chans)):
+            while True:
+                ch = self._current_chan(i)
+                with self._cv:
+                    if self._end_sent[i]:
+                        break  # a heal already terminated this channel
+                try:
+                    ch.send_end()
+                    with self._cv:
+                        self._end_sent[i] = True
+                    break
+                except ChannelError:
+                    self._heal(i, ch)
+
+    def flush(self, timeout: float | None = None) -> None:
+        for i in range(len(self._chans)):
+            self._current_chan(i).flush(timeout=timeout)
+
+    @staticmethod
+    def _join_chan(ch, timeout: float | None) -> None:
+        """Wait for a channel whose END is already queued to drain and
+        exit (AsyncSender.close without the second END)."""
+        ch._thread.join(timeout)
+        if ch.err is not None:
+            raise ChannelError("transport tx thread died") from ch.err
+        if ch._thread.is_alive():
+            raise TimeoutError(
+                f"tx queue did not drain in {timeout:.1f}s")
+
+    def close(self, timeout: float | None = None) -> None:
+        """END every channel and join them, healing channels that die
+        with unacked frames still owed (their replay + END completes
+        the stream on the respawned replica); the first terminal
+        failure is raised after every channel got its close attempt."""
+        self._end_queued = True
+        first: BaseException | None = None
+        for i in range(len(self._chans)):
+            attempts = 0
+            while True:
+                try:
+                    ch = self._current_chan(i)
+                except ChannelError as e:
+                    first = first or e
+                    break
+                with self._cv:
+                    ended = self._end_sent[i]
+                try:
+                    if not ended:
+                        ch.send_end()
+                        with self._cv:
+                            self._end_sent[i] = True
+                    self._join_chan(ch, timeout)
+                    break
+                except ChannelError:
+                    attempts += 1
+                    if attempts > 3:
+                        first = first or ChannelError(
+                            f"replica channel {i} kept dying during "
+                            f"close")
+                        break
+                    try:
+                        self._heal(i, ch)
+                    except BaseException as e:  # noqa: BLE001
+                        first = first or e
+                        break
+                except TimeoutError as e:
+                    first = first or e
+                    break
+        self._closing = True
+        if first is not None:
+            raise first

@@ -55,15 +55,27 @@ class FanInMerge:
     once every upstream ended and the buffer drained.
     """
 
-    def __init__(self, expected: int, *, capacity: int = 32):
+    def __init__(self, expected: int, *, capacity: int = 32,
+                 replay_window: int = 0):
         if expected < 1:
             raise ValueError(f"expected must be >= 1, got {expected}")
         if capacity < max(expected, 1):
             # fewer slots than upstreams could park every reader with the
             # needed frame still in a socket nobody is reading
             raise ValueError(f"capacity {capacity} < expected {expected}")
+        if replay_window < 0:
+            raise ValueError(f"replay_window must be >= 0, "
+                             f"got {replay_window}")
         self.expected = expected
         self.capacity = capacity
+        #: failover tolerance: a duplicate/stale seq within this many
+        #: positions behind the stream head is DROPPED silently (a
+        #: healed fan-out replayed frames its acks had not yet covered,
+        #: docs/ROBUSTNESS.md) instead of raising.  0 keeps the strict
+        #: contract: any duplicate raises.
+        self.replay_window = replay_window
+        #: duplicates silently absorbed inside the replay window
+        self.duplicates = 0
         self._buf: dict[int, object] = {}
         self._ctrl: list[dict] = []
         self._next = 0
@@ -85,6 +97,12 @@ class FanInMerge:
                 if self._err is not None:
                     raise self._err
                 if seq < self._next or seq in self._buf:
+                    if self.replay_window > 0 \
+                            and seq >= self._next - self.replay_window:
+                        # failover replay overlap: already merged (or
+                        # already buffered) — absorb, don't corrupt
+                        self.duplicates += 1
+                        return
                     raise ValueError(
                         f"duplicate/stale sequence {seq} "
                         f"(next expected {self._next})")
@@ -175,6 +193,13 @@ class FanInMerge:
     def qsize(self) -> int:
         with self._cv:
             return len(self._buf)
+
+    @property
+    def next_seq(self) -> int:
+        """The cumulative merge position: every seq below this has been
+        released in order — exactly what a ``replay_ack`` carries."""
+        with self._cv:
+            return self._next
 
 
 class FanOutSender:

@@ -1,0 +1,542 @@
+"""The seq-replay substrate (docs/ROBUSTNESS.md): retain-until-ack
+windows, replay-tolerant fan-in dedup, channel healing over real
+sockets, per-stage quiesce, and the live-replan cutover.
+
+The correctness claim under test is byte-identity: a stream that
+crosses a failover or a mid-stream replan must equal the undisturbed
+run bit for bit — no dropped, duplicated, or reordered frame.  The
+unit layers here pin the properties that claim reduces to.
+"""
+
+import queue
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from defer_tpu import partition
+from defer_tpu.models import resnet_tiny
+from defer_tpu.obs.events import recorder
+from defer_tpu.plan.cost import StageCostModel
+from defer_tpu.plan.replan import LiveReplan, replan
+from defer_tpu.plan.solver import solve
+from defer_tpu.runtime.node import ChainDispatcher, StageNode
+from defer_tpu.serve import poisson_trace
+from defer_tpu.transport.framed import (K_CTRL, K_END, recv_frame,
+                                        send_ctrl)
+from defer_tpu.transport.replay import ReplayBuffer, ReplayFanOut
+from defer_tpu.transport.replicate import FanInMerge
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    g = resnet_tiny()
+    return g, g.init(jax.random.key(0))
+
+
+# ---------------------------------------------------------------------------
+# ReplayBuffer: the bounded retain-until-ack window
+# ---------------------------------------------------------------------------
+
+def test_replay_buffer_retain_ack_release():
+    b = ReplayBuffer(8)
+    for s in range(5):
+        b.retain(s, f"f{s}")
+    assert b.depth() == 5 and b.hi == 5
+    assert b.unacked() == [(s, f"f{s}") for s in range(5)]
+    b.ack(3)  # cumulative: 0..2 released
+    assert b.depth() == 2
+    assert [s for s, _ in b.unacked()] == [3, 4]
+    b.ack(1)  # stale ack: no-op (acks race across R relay paths)
+    assert b.depth() == 2 and b.acked == 3
+    b.retain(2, "late")  # already-acked seq: no-op
+    assert b.depth() == 2
+
+
+def test_replay_buffer_full_window_blocks_until_ack():
+    b = ReplayBuffer(2)
+    b.retain(0, "a")
+    b.retain(1, "b")
+    parked = threading.Event()
+    done = threading.Event()
+
+    def producer():
+        parked.set()
+        b.retain(2, "c", timeout=30.0)
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    parked.wait(5.0)
+    time.sleep(0.2)
+    assert not done.is_set(), "full window must backpressure the sender"
+    b.ack(1)
+    t.join(timeout=10)
+    assert done.is_set()
+    with pytest.raises(TimeoutError, match="replay window full"):
+        b.retain(3, "d", timeout=0.1)
+
+
+def test_replay_buffer_fail_wakes_parked_producer():
+    b = ReplayBuffer(1)
+    b.retain(0, "a")
+    errs: list = []
+
+    def producer():
+        try:
+            b.retain(1, "b", timeout=30.0)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    b.fail(ConnectionError("replica gone"))
+    t.join(timeout=10)
+    assert errs and isinstance(errs[0], ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# FanInMerge under replay: dedup inside the window
+# ---------------------------------------------------------------------------
+
+def test_merge_dedups_replay_overlap_inside_window():
+    """A healed fan-out replays frames its acks had not covered; the
+    merge absorbs the overlap silently and the released stream is
+    untouched."""
+    m = FanInMerge(1, capacity=8, replay_window=4)
+    for s in range(4):
+        m.put(s, f"v{s}")
+    got = [m.get(1.0)[1] for _ in range(4)]
+    # failover replay: frames 2, 3 re-arrive (already merged)
+    m.put(2, "v2")
+    m.put(3, "v3")
+    m.put(4, "v4")
+    assert m.get(1.0)[1] == "v4"
+    assert got == ["v0", "v1", "v2", "v3"]
+    assert m.duplicates == 2
+
+
+def test_merge_replay_window_still_rejects_ancient_seqs():
+    """The window is a tolerance, not amnesia: a seq older than the
+    window behind the head is a protocol violation, not a replay."""
+    m = FanInMerge(1, capacity=8, replay_window=2)
+    for s in range(5):
+        m.put(s, s)
+        m.get(1.0)
+    m.put(3, 3)  # inside the window: absorbed
+    assert m.duplicates == 1
+    with pytest.raises(ValueError, match="duplicate/stale"):
+        m.put(0, 0)  # 0 < next(5) - window(2): ancient
+
+
+def test_merge_strict_mode_unchanged_without_window():
+    m = FanInMerge(1, capacity=8)
+    m.put(0, "a")
+    m.get(1.0)
+    with pytest.raises(ValueError, match="duplicate/stale"):
+        m.put(0, "a")
+
+
+def test_merge_dedup_property_random_replay_overlaps():
+    """Property test: for random streams with random failover-replay
+    overlaps (any slice of the trailing window, re-put at any point),
+    the released stream is exactly 0..N-1 in order and every overlap
+    frame is counted as a duplicate."""
+    rng = np.random.default_rng(42)
+    for trial in range(25):
+        window = int(rng.integers(1, 9))
+        n = int(rng.integers(10, 50))
+        m = FanInMerge(1, capacity=64, replay_window=window)
+        out: list = []
+        dups = 0
+        for s in range(n):
+            m.put(s, s)
+            if rng.random() < 0.35:
+                # replay a random slice of the last `window` positions
+                lo = max(0, s + 1 - window)
+                start = int(rng.integers(lo, s + 1))
+                for r in range(start, s + 1):
+                    m.put(r, r)
+                    dups += 1
+            while True:
+                try:
+                    out.append(m.get_nowait()[1])
+                except queue.Empty:
+                    break
+        m.end()
+        while True:
+            try:
+                kind, v = m.get_nowait()
+            except queue.Empty:
+                break
+            if kind == K_END:
+                break
+            out.append(v)
+        assert out == list(range(n)), \
+            f"trial {trial} (window={window}): stream corrupted"
+        assert m.duplicates == dups, f"trial {trial}"
+
+
+# ---------------------------------------------------------------------------
+# ReplayFanOut: heal over real sockets
+# ---------------------------------------------------------------------------
+
+def _replica_reader(conn, frames: list, stop_after: int | None = None):
+    """Minimal fan-in stand-in: record seq-stamped frames; on END play
+    the clean-shutdown half of the ack protocol (final cumulative ack +
+    ``replay_done``); with ``stop_after``, stop early instead — the
+    caller closes the socket to simulate death mid-stream."""
+    try:
+        while True:
+            kind, value = recv_frame(conn)
+            if kind == K_END:
+                if frames:
+                    hi = max(int(seq) for seq, _ in frames) + 1
+                    send_ctrl(conn, {"cmd": "replay_ack", "seq": hi})
+                send_ctrl(conn, {"cmd": "replay_done"})
+                return
+            if kind == K_CTRL:
+                continue
+            frames.append(value)
+            if stop_after is not None and len(frames) >= stop_after:
+                return
+    except (OSError, ConnectionError):
+        pass
+
+
+def test_fanout_heals_dead_channel_and_replays_unacked():
+    """Single-channel fan-out against a replica that acks part of its
+    window and then dies: the heal redials the same address, re-sends
+    the preamble, replays exactly the unacked frames, and later sends
+    continue on the new connection."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    srv.settimeout(30.0)
+    port = srv.getsockname()[1]
+    s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    conn1, _ = srv.accept()
+    fo = ReplayFanOut([s], [("127.0.0.1", port)], window=64,
+                      redial_timeout_s=15.0, replay_gauge=None)
+    second: list = []
+    accepted2 = threading.Event()
+
+    def acceptor2():
+        conn2, _ = srv.accept()
+        accepted2.set()
+        _replica_reader(conn2, second)
+        conn2.close()
+
+    t2 = threading.Thread(target=acceptor2, daemon=True)
+    try:
+        fo.send_ctrl({"cmd": "stream_begin", "stage": 0})
+        xs = [np.full((2,), i, np.float32) for i in range(10)]
+        for x in xs[:4]:
+            fo.send(x)
+        fo.flush(timeout=10.0)
+        first: list = []
+        rt = threading.Thread(target=_replica_reader,
+                              args=(conn1, first, 4), daemon=True)
+        rt.start()
+        rt.join(timeout=10)
+        assert len(first) == 4
+        # ack frames 0, 1 then die without replay_done
+        send_ctrl(conn1, {"cmd": "replay_ack", "seq": 2})
+        deadline = time.monotonic() + 10
+        while fo.replay_depth() > 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fo.replay_depth() == 2
+        t2.start()
+        conn1.close()  # replica death: EOF on the ack reader
+        assert accepted2.wait(20.0), "heal never redialed"
+        for x in xs[4:]:
+            fo.send(x)
+        fo.send_end()
+        fo.close(timeout=15.0)
+        assert fo.failovers == 1
+        # new connection saw the replayed window (2, 3) then the rest,
+        # stamped with their original seqs — no renumbering
+        seqs = [int(seq) for seq, _ in second]
+        assert seqs == [2, 3] + list(range(4, 10))
+        for seq, arr in second:
+            np.testing.assert_array_equal(arr, xs[int(seq)])
+        evs = [e for e in recorder().snapshot()
+               if e["kind"] == "failover"
+               and e["data"].get("addr") == f"127.0.0.1:{port}"]
+        assert evs and evs[-1]["data"]["replayed"] == 2
+        assert evs[-1]["data"]["recovery_ms"] > 0
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# quiesce + live replan (persist-mode in-process chain)
+# ---------------------------------------------------------------------------
+
+def _boot_persist_chain(n: int):
+    nodes = [StageNode(None, "127.0.0.1:0", None, persist=True)
+             for _ in range(n)]
+    addrs = [f"127.0.0.1:{node.address[1]}" for node in nodes]
+    threads = [threading.Thread(target=node.serve, daemon=True)
+               for node in nodes]
+    for t in threads:
+        t.start()
+    return addrs, threads
+
+
+def test_quiesce_returns_stable_sequence_points(tiny):
+    g, params = tiny
+    addrs, threads = _boot_persist_chain(2)
+    disp = ChainDispatcher(addrs[0], codec="raw")
+    try:
+        disp.deploy(partition(g, num_stages=2), params, addrs, batch=1)
+        rng = np.random.default_rng(3)
+        xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+              for _ in range(3)]
+        outs = disp.stream(xs)
+        assert len(outs) == 3
+        processed = disp.quiesce(addrs, timeout_s=30.0)
+        assert processed == [3, 3], \
+            "every stage must quiesce at the same stream position"
+        evs = [e for e in recorder().snapshot() if e["kind"] == "quiesce"]
+        assert len(evs) >= 2
+    finally:
+        disp.end_stream()
+        disp.shutdown_nodes(addrs)
+        disp.close()
+        for t in threads:
+            t.join(timeout=30)
+
+
+def test_live_replan_cutover_byte_identical_under_bursty_arrivals(tiny):
+    """The acceptance path end to end: a bursty arrival trace feeds a
+    persist chain, measured telemetry drives the straggler/replanner
+    suggestion, ``ReplanResult.apply`` cuts the chain over mid-stream,
+    and the full output stream is byte-identical to two undisturbed
+    runs (old cuts then new cuts) over the same inputs."""
+    g, params = tiny
+    cost = StageCostModel(g)
+    plan1 = solve(g, 3, cost)
+    rng = np.random.default_rng(7)
+    xs = [rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+          for _ in range(10)]
+    cut = 6
+    # open-loop bursty arrivals for segment 1 (compressed to keep the
+    # test fast: the point is jittered, bursty feed timing, not wall
+    # time)
+    offsets = poisson_trace(200.0, 1.0, seed=5,
+                            bursts=[(0.2, 0.5, 3.0)])[:cut]
+    while len(offsets) < cut:
+        offsets.append(offsets[-1] if offsets else 0.0)
+
+    addrs, threads = _boot_persist_chain(3)
+    disp = ChainDispatcher(addrs[0], codec="raw")
+    disp.deploy(partition(g, list(plan1.cuts)), params, addrs, batch=1)
+    live = LiveReplan(disp, g, params, addrs, batch=1)
+
+    def bursty(inputs):
+        t0 = time.monotonic()
+        for off, x in zip(offsets, inputs):
+            lag = t0 + off * 0.2 - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            yield x
+
+    outs = disp.stream(bursty(xs[:cut]))
+    # the straggler path: measured per-stage seconds showing stage 0
+    # hot drive the replanner's suggestion; its apply() performs the
+    # cutover only when the suggestion actually moves the cuts
+    measured = {0: 0.5, 1: 0.001, 2: 0.001}
+    result = replan(g, plan1, measured, cost)
+    assert result.moved, "a 500x stage-0 correction must move the cuts"
+    receipt = result.apply(live, min_improvement=1.0)
+    assert receipt is not None
+    assert receipt["stages"] == 3
+    assert receipt["quiesced"] == [cut, cut, cut]
+    outs += disp.stream(xs[cut:])
+    disp.close()
+    live.shutdown()
+    for t in threads:
+        t.join(timeout=30)
+    assert live.cutovers == 1
+    evs = [e for e in recorder().snapshot() if e["kind"] == "cutover"]
+    assert evs and evs[-1]["data"]["stages"] == 3
+
+    def plain(cuts, inputs):
+        nodes = [StageNode(None, "127.0.0.1:0", None) for _ in range(3)]
+        p_addrs = [f"127.0.0.1:{n.address[1]}" for n in nodes]
+        ths = [threading.Thread(target=n.serve, daemon=True)
+               for n in nodes]
+        for t in ths:
+            t.start()
+        d = ChainDispatcher(p_addrs[0], codec="raw")
+        d.deploy(partition(g, list(cuts)), params, p_addrs, batch=1)
+        got = d.stream(inputs)
+        d.close()
+        for t in ths:
+            t.join(timeout=30)
+        return got
+
+    ref = plain(plan1.cuts, xs[:cut]) \
+        + plain(result.new_plan.cuts, xs[cut:])
+    assert len(outs) == len(ref) == len(xs)
+    for i, (y, r) in enumerate(zip(outs, ref)):
+        np.testing.assert_array_equal(y, r, err_msg=f"sample {i}")
+
+
+def test_replan_apply_skips_unmoved_suggestions(tiny):
+    g, params = tiny
+    cost = StageCostModel(g)
+    plan1 = solve(g, 3, cost)
+    # telemetry that matches the prediction: nothing should move
+    result = replan(g, plan1, {}, cost)
+    assert not result.moved
+
+    class _Boom:
+        def apply(self, *_a, **_k):  # pragma: no cover
+            raise AssertionError("unmoved suggestion must not cut over")
+
+    assert result.apply(_Boom()) is None
+
+
+# ---------------------------------------------------------------------------
+# front door: chain backend dies mid-request
+# ---------------------------------------------------------------------------
+
+class _DyingDispatcher:
+    """A chain whose send path dies on first use (the in-process twin
+    of a killed chain): recv_result stays silent, send raises."""
+
+    codec = "raw"
+
+    def __init__(self):
+        self.sent = 0
+
+    def begin_trace(self, **_kw):
+        pass
+
+    def send_request_frame(self, *_a, **_kw):
+        self.sent += 1
+        raise ConnectionError("chain backend died")
+
+    def recv_result(self, timeout_s=1.0):
+        time.sleep(min(timeout_s, 0.05))
+        raise TimeoutError
+
+    def close(self):
+        pass
+
+
+def test_frontdoor_backend_lost_sheds_and_settles_exactly_once():
+    """A chain backend dying mid-request must shed every affected
+    tenant with retry_after_ms, settle each admission slot exactly
+    once, emit backend_lost, and keep the door answering (degraded)
+    instead of failing the healthcheck."""
+    from defer_tpu.serve import ServeClient
+    from defer_tpu.serve.frontdoor import ChainBackend, ServeFrontDoor
+
+    backend = ChainBackend(_DyingDispatcher(), 2, (4,))
+    door = ServeFrontDoor(backend=backend, seed_service_s=0.01).start()
+    host, port = door.address
+    try:
+        c = ServeClient(host, port, "victim")
+        res = c.stream([np.zeros(4, np.float32) for _ in range(3)])
+        assert len(res) == 3
+        kinds = {k for k, *_ in res}
+        assert kinds == {"shed"}, \
+            "every in-flight unit must come back as a shed, not hang"
+        for _k, msg, _t in res:
+            assert msg["reason"] == "backend_lost"
+            assert msg["retry_after_ms"] > 0
+        # slots settled exactly once: nothing left admitted
+        assert door.admission.inflight == 0
+        assert door.admission.queue.qsize() == 0
+        # degraded, not dead: the healthcheck passes and the state is
+        # visible in the pressure snapshot instead
+        door.healthcheck()
+        assert door.stats()["pressure"]["backend_lost"] is True
+        # the sweep emits the event just after releasing the last
+        # client; give its thread a beat
+        deadline = time.monotonic() + 5.0
+        evs: list = []
+        while not evs and time.monotonic() < deadline:
+            evs = [e for e in recorder().snapshot()
+                   if e["kind"] == "backend_lost"]
+            if not evs:
+                time.sleep(0.02)
+        assert evs and evs[-1]["data"]["shed"] >= 1
+        # a NEW sample after the loss sheds at ingest with the same
+        # contract — the door never admits into a dead chain
+        c2 = ServeClient(host, port, "late")
+        res2 = c2.stream([np.zeros(4, np.float32)])
+        assert res2[0][0] == "shed"
+        assert res2[0][1]["reason"] == "backend_lost"
+        assert door.admission.inflight == 0
+    finally:
+        door.stop()
+
+
+# ---------------------------------------------------------------------------
+# kill -9 failover, full multi-process chain (slow)
+# ---------------------------------------------------------------------------
+
+_KILL_SCRIPT = r"""
+import os, signal, sys, threading, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+from defer_tpu import partition
+from defer_tpu.models import resnet_tiny
+from defer_tpu.runtime.node import run_chain
+
+g = resnet_tiny()
+params = g.init(jax.random.key(0))
+stages = partition(g, num_stages=3)
+rng = np.random.default_rng(0)
+xs = [rng.standard_normal((1,) + stages[0].in_spec.shape)
+      .astype(np.float32) for _ in range(16)]
+started = threading.Event()
+
+def feeder():
+    for i, x in enumerate(xs):
+        if i == 6:
+            started.set()
+        yield x
+
+def on_spawn(procs):
+    def killer():
+        started.wait(180)
+        time.sleep(0.3)
+        procs[1].send_signal(signal.SIGKILL)  # stage 1, replica 0
+    threading.Thread(target=killer, daemon=True).start()
+
+outs = run_chain(stages, params, feeder(), batch=1, replicas={1: 2},
+                 failover=True, on_spawn=on_spawn,
+                 artifact_dir=sys.argv[1],
+                 stage_delays=[0.0, 0.4, 0.0])
+ref = run_chain(stages, params, list(xs), batch=1,
+                artifact_dir=sys.argv[1])
+assert len(outs) == len(ref) == len(xs), (len(outs), len(ref))
+for a, b in zip(outs, ref):
+    np.testing.assert_array_equal(a, b)
+print("BYTE-IDENTICAL", len(outs))
+"""
+
+
+@pytest.mark.slow
+def test_kill9_replica_failover_byte_identical(tmp_path):
+    """kill -9 a mid-chain replica while the stream is in flight: the
+    supervisor respawns it, the fan-out heals + replays, and the output
+    equals the undisturbed run byte for byte."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT, str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "BYTE-IDENTICAL 16" in proc.stdout
