@@ -1,0 +1,144 @@
+"""Trend gate over the append-only benchmark ledger.
+
+``benchmarks/run.py`` appends one JSON row per metric per run to
+``BENCH_LEDGER.jsonl`` (successes AND explicit failure rows).  This
+script reads that trajectory and flags REGRESSIONS: for every metric,
+each successful row is compared against the PREVIOUS successful row of
+the same metric, and a drop of more than ``--threshold`` (fraction,
+default 0.30) is a regression.  Higher-is-better is assumed — every
+ledger metric today is a throughput (inf/s, tokens/sec) or a ratio
+where bigger means healthier; a metric whose polarity flips must grow
+an entry in ``LOWER_IS_BETTER`` below, not a silent sign hack.
+
+Failure rows (``status: "failed"``) are reported but never compared —
+a run that did not measure cannot regress, and the NEXT successful row
+is compared against the last successful one, skipping the gap.
+
+Exit codes:
+  0  no regressions (including: ledger missing, empty, or every metric
+     has fewer than two successful rows — a short history is not a
+     failure, it is the absence of a trend)
+  1  at least one regression past the threshold
+
+CI runs this warn-only (``continue-on-error``): the ledger in a fresh
+checkout is usually absent, and a genuine regression should page a
+human via the log, not mask an unrelated PR.
+
+Usage:
+  python benchmarks/check_ledger.py
+  python benchmarks/check_ledger.py --threshold 0.15 --ledger path.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: metrics where a DROP is an improvement (none today; see module doc)
+LOWER_IS_BETTER: frozenset = frozenset()
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def load_rows(path: str) -> list:
+    """Parse the JSON-lines ledger, skipping (and counting) unparsable
+    lines loudly — a corrupt line must not silently hide the rows
+    after it."""
+    rows = []
+    bad = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                bad += 1
+                log(f"check_ledger: {path}:{lineno}: unparsable row "
+                    f"skipped ({e})")
+    if bad:
+        log(f"check_ledger: {bad} unparsable line(s) skipped")
+    return rows
+
+
+def check(rows: list, threshold: float) -> list:
+    """Return the list of regression records (possibly empty)."""
+    last_ok: dict = {}          # metric -> (value, run_unix)
+    regressions = []
+    for row in rows:
+        metric = row.get("metric")
+        if metric is None:
+            continue
+        if row.get("status") == "failed":
+            log(f"check_ledger: {metric}: failure row "
+                f"({row.get('reason', 'no reason')!r}) — not compared")
+            continue
+        value = row.get("value")
+        if not isinstance(value, (int, float)):
+            continue
+        prev = last_ok.get(metric)
+        last_ok[metric] = (float(value), row.get("run_unix"))
+        if prev is None:
+            continue
+        prev_value, prev_run = prev
+        if prev_value == 0:
+            continue            # no meaningful ratio against zero
+        delta = (float(value) - prev_value) / abs(prev_value)
+        if metric in LOWER_IS_BETTER:
+            delta = -delta
+        if delta < -threshold:
+            regressions.append({
+                "metric": metric,
+                "prev": prev_value,
+                "value": float(value),
+                "drop_frac": round(-delta, 4),
+                "prev_run_unix": prev_run,
+                "run_unix": row.get("run_unix"),
+            })
+    return regressions
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    default_ledger = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_LEDGER.jsonl")
+    ap.add_argument("--ledger", default=default_ledger, metavar="FILE")
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="fractional drop vs the previous successful "
+                         "row of the same metric that counts as a "
+                         "regression (default 0.30)")
+    args = ap.parse_args()
+
+    if not os.path.exists(args.ledger):
+        log(f"check_ledger: no ledger at {args.ledger} — nothing to "
+            f"gate (fresh checkout?)")
+        return 0
+    rows = load_rows(args.ledger)
+    if not rows:
+        log("check_ledger: ledger is empty — nothing to gate")
+        return 0
+
+    regressions = check(rows, args.threshold)
+    n_metrics = len({r.get("metric") for r in rows
+                     if r.get("metric") is not None})
+    if not regressions:
+        log(f"check_ledger: OK — {len(rows)} row(s) across "
+            f"{n_metrics} metric(s), no drop past "
+            f"{args.threshold:.0%}")
+        return 0
+    for r in regressions:
+        log(f"check_ledger: REGRESSION {r['metric']}: "
+            f"{r['prev']} -> {r['value']} "
+            f"(-{r['drop_frac']:.1%}, threshold {args.threshold:.0%})")
+    print(json.dumps({"regressions": regressions,
+                      "threshold": args.threshold}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

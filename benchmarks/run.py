@@ -353,6 +353,12 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: mid-stream quiesce -> redeploy -> resume cutover onto the same
 #: persist processes, byte-identical with its cutover_ms —
 #: docs/ROBUSTNESS.md)
+#: ... and `decode_profile` (the decode steady-state X-ray: after one
+#: warmup generate, a second identical generate must reach XLA ZERO
+#: times — measured by the jax.monitoring compile listener — with
+#: EXACTLY ceil(num_steps/chunk_steps) scan dispatches and a dispatch
+#: share <= ~1 of the generation wall; the guard rail under the mb64
+#: decode-cliff autopsy in docs/DECODE_CLIFF.md)
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "pipeline_failover": "chaos_smoke.py",
@@ -366,6 +372,7 @@ SCRIPT_ROWS = {
     "request_attribution": "request_obs_smoke.py",
     "dag_pipeline": "dag_smoke.py",
     "cost_model_truth": "capacity_smoke.py",
+    "decode_profile": "decode_profile_smoke.py",
 }
 
 

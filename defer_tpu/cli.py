@@ -934,7 +934,8 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool,
         print("\x1b[2J\x1b[H", end="")
     print(f"{'STAGE':>5} {'BR':>3} {'REP':>3} {'TIER':>5} {'INF/S':>8} "
           f"{'P50MS':>9} "
-          f"{'P95MS':>9} {'P99MS':>9} {'HS50':>7} {'MFU%':>6} "
+          f"{'P95MS':>9} {'P99MS':>9} {'HS50':>7} {'DISP':>7} "
+          f"{'DEV':>7} {'MEM':>7} {'MFU%':>6} "
           f"{'PRED':>9} {'MEAS':>9} {'ERR%':>7} "
           f"{'RXQ':>4} {'TXQ':>4} "
           f"{'RX^':>4} {'TX^':>4} {'INF':>4} {'RX B/S':>11} "
@@ -964,6 +965,17 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool,
         # ici (device-resident) hop's proof mark
         hs = r.get("host_sync_ms") or {}
         hs50 = "-" if not hs.get("count") else f"{hs.get('p50', 0):.3f}"
+        # phase X-ray p50s (obs/profile.py): dispatch (the jit call
+        # returning) / device (block_until_ready) next to HS50 — "-"
+        # at zero samples, same convention
+        dp = r.get("dispatch_ms") or {}
+        disp = "-" if not dp.get("count") else f"{dp.get('p50', 0):.3f}"
+        dv = r.get("device_ms") or {}
+        dev = "-" if not dv.get("count") else f"{dv.get('p50', 0):.3f}"
+        # live device-array megabytes — "-" from a process that never
+        # loaded jax (None on the wire; a fake 0 would be a lie)
+        mem = "-" if r.get("mem_bytes") is None \
+            else f"{r['mem_bytes'] / 1e6:.1f}M"
         # MFU is "-" unless the node reported an HONEST figure (known
         # chip peak + deployed capacity) — a fabricated 0.0 would be
         # indistinguishable from a real idle chip
@@ -976,7 +988,8 @@ def _render_monitor(rows, bottleneck, flags, offsets, *, clear: bool,
         line = (f"{stage:>5} {br:>3} {rep:>3} {tier:>5} "
                 f"{r['throughput_per_s']:>8.1f} "
                 f"{p['p50']:>9.3f} {p['p95']:>9.3f} {p['p99']:>9.3f} "
-                f"{hs50:>7} {mfu:>6} {pred:>9} {meas:>9} {errp:>7} "
+                f"{hs50:>7} {disp:>7} {dev:>7} {mem:>7} "
+                f"{mfu:>6} {pred:>9} {meas:>9} {errp:>7} "
                 f"{r['rx_q']:>4.0f} {r['tx_q']:>4.0f} "
                 f"{r['rx_hi']:>4.0f} {r['tx_hi']:>4.0f} "
                 f"{r['inflight']:>4.0f} {r['rx_bytes_per_s']:>11.0f} "
@@ -1244,6 +1257,20 @@ def cmd_monitor(args):
     if not addrs and not args.serve:
         raise SystemExit("monitor requires --nodes host:port[,...] "
                          "and/or --serve host:port")
+    # --follow is a pure event tail (implies --events); --kind narrows
+    # both the tail and the table's event footer to the listed kinds
+    kind_filter = {k for k in (getattr(args, "kind", "") or ""
+                               ).split(",") if k}
+    if kind_filter:
+        from .obs.events import EVENT_KINDS
+        unknown = kind_filter - set(EVENT_KINDS)
+        if unknown:
+            raise SystemExit(f"--kind: unknown event kind(s) "
+                             f"{sorted(unknown)}; known: "
+                             f"{sorted(EVENT_KINDS)}")
+    follow = bool(getattr(args, "follow", False))
+    if follow:
+        args.events = True
     detector = plan = graph = auditor = None
     if args.plan:
         from .plan import plan_from_json
@@ -1310,6 +1337,26 @@ def cmd_monitor(args):
                     except (OSError, ConnectionError):
                         pass
                 events = merge_events(batch)
+                if kind_filter:
+                    events = [e for e in events
+                              if e["kind"] in kind_filter]
+            if follow:
+                # tail mode: one line per merged event as it arrives —
+                # a fleet-wide recompile/failover storm watched live
+                # instead of re-polled; no table, no clearing
+                for ev in events or []:
+                    if args.json:
+                        print(json.dumps(ev), flush=True)
+                    else:
+                        data = " ".join(
+                            f"{k}={v}" for k, v in
+                            sorted(ev["data"].items()))
+                        print(f"{ev['t_us'] / 1e6:16.6f} "
+                              f"[{ev['kind']:>14}] {ev['proc']}"
+                              f"#{ev['seq']} {data}", flush=True)
+                if args.iterations and i >= args.iterations:
+                    return
+                continue
             serve_doc = None
             if args.serve:
                 from .serve.client import fetch_stats
@@ -1387,6 +1434,136 @@ def cmd_monitor(args):
         pass
     finally:
         view.close()
+
+
+def cmd_profile(args):
+    """Attach to a running chain's nodes for N seconds and produce the
+    stage-interior X-ray (docs/OBSERVABILITY.md §Profiling): per node a
+    ``profile_start``/``profile_stop`` bracket over the existing ctrl
+    connection (the obs_subscribe pattern — no new ports) whose stop
+    reply carries the window's DELTA phase breakdown
+    (dispatch/device/host_sync counts + summed seconds), recompiles,
+    and live device memory; optionally the sampled spans, dumped and
+    clock-shifted onto THIS process's timeline (passive: the nodes'
+    own anchors are never touched) and exported as one merged Perfetto
+    trace.  Machine-readable JSON on stdout (or --out)."""
+    import os
+
+    from .obs import tracer
+    from .obs.cluster import estimate_clock_offset
+    from .runtime.node import _connect_retry, _parse_hostport
+    from .transport.framed import (K_CTRL, recv_expect, send_ctrl,
+                                   send_end)
+
+    addrs = [a for a in (args.nodes or "").split(",") if a]
+    if not addrs:
+        raise SystemExit("profile requires --nodes host:port[,...]")
+    want_spans = args.spans or bool(args.trace_out)
+    tr = tracer()
+    conns: dict = {}
+    offsets: dict = {}
+    reports: dict = {}
+    try:
+        for addr in addrs:
+            s = _connect_retry(*_parse_hostport(addr),
+                               timeout_s=args.connect_timeout)
+            conns[addr] = s
+            # passive min-RTT offset estimate per node: dumped spans
+            # are shifted HERE — an observer must not re-anchor spans
+            # a dispatcher may already have aligned
+            offsets[addr] = estimate_clock_offset(s)
+        if want_spans:
+            tr.enabled = True
+            tid = tr.start_trace()
+            tr.process = "profiler"
+            for addr, s in conns.items():
+                send_ctrl(s, {"cmd": "trace", "trace_id": tid,
+                              "sample_every": max(0, args.sample_every)})
+        for addr, s in conns.items():
+            msg: dict = {"cmd": "profile_start"}
+            if args.jax_trace_dir:
+                # per-node subdir: the node runs jax.profiler.trace
+                # locally where the backend supports it
+                msg["jax_trace_dir"] = os.path.join(
+                    args.jax_trace_dir, addr.replace(":", "_"))
+            send_ctrl(s, msg)
+            rep = recv_expect(s, K_CTRL)
+            if rep.get("cmd") != "profile_started":
+                raise SystemExit(f"profile_start on {addr} refused: "
+                                 f"{rep.get('error', rep)}")
+        time.sleep(args.seconds)
+        for addr, s in conns.items():
+            send_ctrl(s, {"cmd": "profile_stop"})
+            rep = recv_expect(s, K_CTRL)
+            if rep.get("cmd") != "profile_report":
+                raise SystemExit(f"profile_stop on {addr} failed: "
+                                 f"{rep.get('error', rep)}")
+            reports[addr] = rep["report"]
+        if want_spans:
+            n_spans = 0
+            for addr, s in conns.items():
+                send_ctrl(s, {"cmd": "trace_dump"})
+                doc = recv_expect(s, K_CTRL)
+                spans = doc.get("spans") or []
+                off = int(round(offsets[addr]["offset_us"]))
+                for sp in spans:
+                    sp["ts_us"] -= off
+                n_spans += len(spans)
+                tr.ingest(spans)
+            if n_spans == 0 and args.sample_every >= 1:
+                # 1-in-N waterfall sampling keys off the wire sequence
+                # stamp so every stage samples the SAME frames; a chain
+                # whose dispatcher doesn't stamp (trace_sample_every=0)
+                # carries no seqs and N>=1 matches nothing.  Say so
+                # instead of silently writing an empty trace.
+                print(f"profile: WARNING: --sample-every "
+                      f"{args.sample_every} returned zero spans — "
+                      f"1-in-N sampling needs sequence-stamped frames "
+                      f"(a dispatcher started with trace_sample_every "
+                      f">= 1).  Re-run with --sample-every 0 to record "
+                      f"every frame on any stream.",
+                      file=sys.stderr, flush=True)
+        for s in conns.values():
+            try:
+                send_end(s)
+            except OSError:
+                pass
+    finally:
+        for s in conns.values():
+            s.close()
+    for addr, rep in reports.items():
+        ph = rep.get("phases") or {}
+        inf = ph.get("infer") or {}
+        dsp = ph.get("dispatch") or {}
+        if inf.get("sum_s"):
+            # the MPK question in one number: how much of the frame
+            # wall is host-side dispatch
+            rep["dispatch_share"] = round(
+                (dsp.get("sum_s") or 0.0) / inf["sum_s"], 4)
+        parts = " ".join(
+            f"{name}={p['sum_s']:.3f}s/{p['count']}"
+            for name, p in ph.items())
+        print(f"{rep.get('node', addr)}: {parts} "
+              f"recompiles={rep.get('recompiles')} "
+              f"mem_bytes={rep.get('mem_bytes')} "
+              f"dispatch_share={rep.get('dispatch_share', '-')}",
+              file=sys.stderr, flush=True)
+    if args.trace_out:
+        from .obs import export_chrome_trace
+        export_chrome_trace(args.trace_out)
+        print(f"profile: merged trace -> {args.trace_out}",
+              file=sys.stderr, flush=True)
+    doc = {"seconds": args.seconds, "nodes": reports,
+           "clock_offsets": {a: round(v["offset_us"], 1)
+                             for a, v in offsets.items()}}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"profile: breakdown -> {args.out}",
+              file=sys.stderr, flush=True)
+    else:
+        print(json.dumps(doc), flush=True)
 
 
 def cmd_train(args):
@@ -1896,12 +2073,51 @@ def main(argv=None):
                          "watched node's obs_push stream and — with "
                          "--serve — the front door's events_since "
                          "endpoint (docs/OBSERVABILITY.md)")
+    mo.add_argument("--kind", default="", metavar="a,b",
+                    help="with --events/--follow: only render events of "
+                         "the listed kinds (comma-separated; e.g. "
+                         "recompile,mem_pressure,failover)")
+    mo.add_argument("--follow", action="store_true",
+                    help="event tail mode (implies --events): one line "
+                         "per merged flight-recorder event as it "
+                         "arrives, no table — watch a fleet-wide "
+                         "recompile/failover storm live")
     mo.add_argument("--align", action="store_true",
                     help="actively clock-ALIGN every node's tracer to "
                          "this process (default: passively estimate "
                          "offsets only — an observer must not re-anchor "
                          "spans the dispatcher already aligned)")
     mo.add_argument("--connect-timeout", type=float, default=30.0)
+
+    pr = sub.add_parser("profile", help="attach to a running chain for "
+                                        "N seconds: per-stage phase "
+                                        "breakdown (dispatch/device/"
+                                        "host_sync), recompile + "
+                                        "memory telemetry, optional "
+                                        "merged Perfetto trace")
+    pr.add_argument("--nodes", required=True, metavar="host:port,...",
+                    help="the chain nodes' listen addresses (same list "
+                         "`stats`/monitor use)")
+    pr.add_argument("--seconds", type=float, default=5.0,
+                    help="profiled window length")
+    pr.add_argument("--out", default="", metavar="FILE",
+                    help="write the per-stage phase-breakdown JSON "
+                         "here (default: one JSON line on stdout)")
+    pr.add_argument("--spans", action="store_true",
+                    help="also collect each node's spans (trace + "
+                         "trace_dump) onto one clock-aligned timeline")
+    pr.add_argument("--trace-out", default="", metavar="FILE",
+                    help="export the merged timeline as Chrome/"
+                         "Perfetto trace JSON (implies --spans)")
+    pr.add_argument("--sample-every", type=int, default=0,
+                    help="span sampling: record every Nth wire "
+                         "sequence (0 = every frame — the window is "
+                         "short)")
+    pr.add_argument("--jax-trace-dir", default="", metavar="DIR",
+                    help="ask each node to wrap the window in "
+                         "jax.profiler.trace writing under DIR/<addr> "
+                         "(backends with a profiler; no-op on cpu)")
+    pr.add_argument("--connect-timeout", type=float, default=30.0)
 
     t = sub.add_parser("train", help="pipeline-parallel training demo "
                                      "(synthetic data, cross-entropy)")
@@ -1945,7 +2161,8 @@ def main(argv=None):
      "bench": cmd_bench, "export": cmd_export, "node": cmd_node,
      "chain": cmd_chain, "monitor": cmd_monitor, "train": cmd_train,
      "generate": cmd_generate, "serve": cmd_serve,
-     "serve-client": cmd_serve_client}[args.cmd](args)
+     "serve-client": cmd_serve_client,
+     "profile": cmd_profile}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,10 @@ from .cluster import (ClusterView, StragglerDetector, StragglerFlag,
 from .capacity import (CapacityModel, DriftAuditor, DriftFlag,
                        achieved_mfu, stage_flops_bytes)
 from .report import ObsReporter, start_prom_server
+from .profile import (ENGINE_PHASES, NODE_PHASES, MemoryWatcher,
+                      ProfileSession, RecompileWatcher,
+                      device_memory_bytes, memory_watcher,
+                      recompile_watcher)
 
 __all__ = [
     "LatencyHistogram",
@@ -48,4 +52,7 @@ __all__ = [
     "CapacityModel", "DriftAuditor", "DriftFlag", "achieved_mfu",
     "stage_flops_bytes",
     "ObsReporter", "start_prom_server",
+    "NODE_PHASES", "ENGINE_PHASES", "ProfileSession",
+    "RecompileWatcher", "recompile_watcher",
+    "MemoryWatcher", "memory_watcher", "device_memory_bytes",
 ]

@@ -383,6 +383,29 @@ class ClusterView:
                                        .get("p50", 0.0)) * 1e3, 4),
                     "count": int((lat.get("host_sync_s") or {})
                                  .get("count", 0))},
+                # the infer X-ray (obs/profile.py): dispatch = the jit
+                # call returning (host-side cost), device =
+                # block_until_ready — the monitor's DISP/DEV columns;
+                # count 0 (rendered "-") from a pre-profiling node
+                "dispatch_ms": {
+                    "p50": round(float((lat.get("dispatch_s") or {})
+                                       .get("p50", 0.0)) * 1e3, 4),
+                    "count": int((lat.get("dispatch_s") or {})
+                                 .get("count", 0))},
+                "device_ms": {
+                    "p50": round(float((lat.get("device_s") or {})
+                                       .get("p50", 0.0)) * 1e3, 4),
+                    "count": int((lat.get("device_s") or {})
+                                 .get("count", 0))},
+                "queue_ms": {
+                    "p50": round(float((lat.get("queue_s") or {})
+                                       .get("p50", 0.0)) * 1e3, 4),
+                    "count": int((lat.get("queue_s") or {})
+                                 .get("count", 0))},
+                # compile/memory telemetry: None from old-vintage or
+                # jax-less processes (rendered "-", never a fake 0)
+                "mem_bytes": last.get("mem_bytes"),
+                "recompiles": last.get("recompiles"),
                 "service_ms": round(_service_ms(last), 4),
                 # window-bounded rolling service (delta-means over the
                 # last few pushes) — the current-regime estimate the

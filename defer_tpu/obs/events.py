@@ -73,6 +73,10 @@ EVENT_KINDS = {
                     "(error, shed)",
     "replica_respawn": "the chain supervisor respawned a dead replica "
                        "(stage, replica, addr, rc)",
+    "recompile": "XLA compiled a program after warmup — one event per "
+                 "episode (count, via, label, shapes)",
+    "mem_pressure": "live device-array bytes crossed the configured "
+                    "threshold (bytes, threshold, live_arrays)",
 }
 
 #: the wire schema's required keys (and the only keys)

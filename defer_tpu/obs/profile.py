@@ -1,0 +1,390 @@
+"""Stage-interior profiling plane (docs/OBSERVABILITY.md §Profiling).
+
+Three instruments that compose with the live observability plane
+instead of replacing it:
+
+* **Phase decomposition** — the compute loops split each frame's
+  opaque ``infer`` interval into named phases (``dispatch``: the jit
+  call returning, ``device``: ``block_until_ready``, ``host_sync``:
+  ``np.asarray``); this module owns the phase NAME table and the
+  session arithmetic over the per-node histograms the loops feed.
+* **Recompile telemetry** — :class:`RecompileWatcher` hooks
+  ``jax.monitoring``'s ``backend_compile_duration`` stream (with a
+  :meth:`~RecompileWatcher.wrap` shape-signature fallback for callables
+  that bypass jit, or for builds without the monitoring events) to
+  count XLA compilations per process and emit ONE ``recompile``
+  flight-recorder event per compile episode — the same
+  emit-once/re-arm discipline as ``model_drift``, so a recompile storm
+  is one log line per burst, not thousands.
+* **Memory telemetry** — :func:`device_memory_bytes` prices the live
+  device arrays (``jax.live_arrays``) without importing jax into a
+  process that never used it; :class:`MemoryWatcher` turns it into the
+  ``device.mem_bytes`` gauge plus a thresholded ``mem_pressure`` event
+  (hysteresis re-arm at 90% of the threshold).
+
+:class:`ProfileSession` is the on-demand half: a node's
+``profile_start``/``profile_stop`` control commands bracket a window
+and reply with the DELTA phase breakdown (counts and summed seconds
+per phase over exactly that window), the recompiles inside it, and the
+live-memory reading — the machine-readable row the ``defer_tpu
+profile`` CLI merges across nodes.  Everything here is off until
+asked for: the watchers are installed lazily and the phase histograms
+are the same always-on-cheap instruments the stats plane already pays
+for.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from .events import emit as emit_event
+from .registry import REGISTRY
+
+#: the named phases of one frame through a stage node's compute loop,
+#: in wall order.  ``dispatch`` + ``queue`` + ``device`` + ``host_sync``
+#: tiles ``infer``, which stays the issue-to-materialize total — the
+#: invariant ``scripts/profile_smoke.py`` asserts.  ``queue`` is the
+#: frame's residency in the async in-flight window between its dispatch
+#: returning and its drain turn: ~0 in the serial loop, and in the
+#: overlapped loop the latency the pipeline HIDES (a large queue share
+#: on a fast stage is overlap working, not time lost).
+NODE_PHASES = ("dispatch", "queue", "device", "host_sync")
+
+#: the decode engine's per-step phases (serve/engine.py): host-side
+#: gather of the per-slot rows, jit dispatch, device wait, host sync of
+#: the sampled ids, and per-slot delivery/bookkeeping.  Sampling and
+#: the KV write happen INSIDE the fused step program, so they are part
+#: of ``device`` here; splitting them needs ``jax.profiler`` (the
+#: profile CLI's --jax-trace), not host timers.
+ENGINE_PHASES = ("gather", "dispatch", "device", "sync", "delivery")
+
+#: the jax.monitoring duration event that fires once per XLA backend
+#: compilation (and never on a program-cache hit)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _fmt_shapes(args) -> list[str]:
+    """``f32[8,128]``-style abstract shapes for event payloads (arrays
+    only; scalars/pytrees are summarized by type name)."""
+    out = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            out.append(f"{dtype}[{','.join(str(s) for s in shape)}]")
+        else:
+            out.append(type(a).__name__)
+    return out
+
+
+class RecompileWatcher:
+    """Counts XLA compilations in this process and emits ONE
+    ``recompile`` flight-recorder event per compile EPISODE.
+
+    An episode is a burst of compiles separated from the previous burst
+    by at least ``episode_gap_s`` of quiet: the first compile of a
+    burst emits (carrying the via/label/shape attribution), the rest
+    only count — so an injected shape change on a hot loop produces
+    exactly one event, and warmup compiles before :meth:`arm` produce
+    none.  Counting is always on once installed; event emission starts
+    at :meth:`arm` (call it after warmup, or never for a silent
+    counter).
+    """
+
+    def __init__(self, *, episode_gap_s: float = 5.0):
+        self.episode_gap_s = float(episode_gap_s)
+        self._lock = threading.Lock()
+        self._installed = False
+        self._armed = False
+        self._last_t: float | None = None
+        self._compiles = REGISTRY.counter("jax.compiles")
+        self._compile_s = REGISTRY.histogram("jax.compile_s")
+
+    @property
+    def count(self) -> int:
+        return self._compiles.value
+
+    def install(self) -> "RecompileWatcher":
+        """Register the ``jax.monitoring`` listener (idempotent; a
+        process that never imports jax can still :meth:`wrap`)."""
+        with self._lock:
+            if self._installed:
+                return self
+            try:
+                import jax.monitoring as _mon
+                _mon.register_event_duration_secs_listener(
+                    self._on_duration)
+            except Exception as e:  # noqa: BLE001 — builds without the
+                # monitoring events fall back to wrap(); counting just
+                # loses the listener path, loudly on stderr once
+                print(f"profile: jax.monitoring unavailable ({e!r}); "
+                      f"recompile counting rides wrap() only",
+                      file=sys.stderr, flush=True)
+            self._installed = True
+            return self
+
+    def arm(self) -> None:
+        """Start (or restart) event emission: the NEXT compile opens a
+        fresh episode and emits.  Call after warmup."""
+        with self._lock:
+            self._armed = True
+            self._last_t = None
+
+    def disarm(self) -> None:
+        """Stop event emission (counting continues — it is always on
+        once installed).  A later :meth:`arm` restarts episodes."""
+        with self._lock:
+            self._armed = False
+
+    # -- the two ingestion paths -------------------------------------------
+
+    def _on_duration(self, name: str, dur: float, **kw) -> None:
+        if name != _COMPILE_EVENT:
+            return
+        self._record(dur, via="jax.monitoring", label=None, shapes=None)
+
+    def wrap(self, fn, label: str = ""):
+        """Shape-signature fallback: returns ``fn`` wrapped so a call
+        whose array signature (shape+dtype per argument) was never seen
+        before is recorded as a compilation — what a jitted callable
+        would do — with the abstract shapes attached to the event.
+        Use when ``jax.monitoring`` is unavailable, or to attribute
+        recompiles to a specific call site by ``label``."""
+        seen: set = set()
+        lock = threading.Lock()
+
+        def wrapped(*args, **kwargs):
+            sig = tuple(_fmt_shapes(args))
+            with lock:
+                fresh = sig not in seen
+                if fresh:
+                    seen.add(sig)
+            if fresh:
+                self._record(0.0, via="wrap", label=label,
+                             shapes=list(sig))
+            return fn(*args, **kwargs)
+
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def _record(self, dur: float, *, via, label, shapes) -> None:
+        self._compiles.inc()
+        if dur:
+            self._compile_s.record(dur)
+        now = time.monotonic()
+        with self._lock:
+            quiet = (self._last_t is None
+                     or now - self._last_t >= self.episode_gap_s)
+            self._last_t = now
+            # episode discipline: only the first compile after
+            # episode_gap_s of quiet emits; the rest of the burst just
+            # counts (re-arming is lazy — no timer thread)
+            fire = self._armed and quiet
+        if fire:
+            data = {"count": self._compiles.value, "via": via}
+            if label:
+                data["label"] = label
+            if shapes:
+                data["shapes"] = shapes
+            emit_event("recompile", **data)
+
+
+def device_memory(ensure: bool = False) -> tuple[int, int] | None:
+    """(total bytes, array count) of this process's live device arrays
+    — ``None`` when jax was never imported here (``ensure=True`` forces
+    the import) or the backend has no ``live_arrays``.  Cheap enough
+    for the obs_push cadence, not for the per-frame hot path."""
+    if "jax" not in sys.modules and not ensure:
+        return None
+    import jax
+    try:
+        arrs = jax.live_arrays()
+    except Exception:  # noqa: BLE001 — backend without live_arrays
+        return None
+    total = 0
+    for a in arrs:
+        try:
+            total += int(a.nbytes)
+        except Exception:  # noqa: BLE001 — deleted/donated buffers
+            pass
+    return total, len(arrs)
+
+
+def device_memory_bytes(ensure: bool = False) -> int | None:
+    mem = device_memory(ensure)
+    return None if mem is None else mem[0]
+
+
+class MemoryWatcher:
+    """Publishes live device-array bytes as the ``device.mem_bytes``
+    gauge and emits a ``mem_pressure`` event when a threshold is
+    crossed (one per excursion: re-arms below 90% of the threshold).
+
+    The threshold, first match wins: :meth:`set_threshold`, the
+    ``DEFER_MEM_PRESSURE_BYTES`` env var (absolute bytes — the testable
+    knob on backends without memory_stats), or
+    ``DEFER_MEM_PRESSURE_FRAC`` (default 0.9) of the device's
+    ``memory_stats()['bytes_limit']`` where the backend reports one.
+    No threshold -> gauge only, no events.
+    """
+
+    def __init__(self):
+        self._threshold: float | None = None
+        self._armed = True
+        self._gauge = REGISTRY.gauge("device.mem_bytes")
+
+    def set_threshold(self, n_bytes: float | None) -> None:
+        self._threshold = None if n_bytes is None else float(n_bytes)
+
+    def threshold_bytes(self) -> float | None:
+        if self._threshold is not None:
+            return self._threshold
+        env = os.environ.get("DEFER_MEM_PRESSURE_BYTES")
+        if env:
+            return float(env)
+        if "jax" not in sys.modules:
+            return None
+        import jax
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:  # noqa: BLE001 — cpu backend: no stats
+            return None
+        limit = stats.get("bytes_limit")
+        if not limit:
+            return None
+        frac = float(os.environ.get("DEFER_MEM_PRESSURE_FRAC", "0.9"))
+        return limit * frac
+
+    def observe(self) -> int | None:
+        """One reading: update the gauge, check the threshold.  Called
+        from obs_snapshot (per push), never per frame."""
+        mem = device_memory()
+        if mem is None:
+            return None
+        n, arrs = mem
+        self._gauge.v = float(n)
+        thr = self.threshold_bytes()
+        if thr:
+            if self._armed and n > thr:
+                self._armed = False
+                emit_event("mem_pressure", bytes=n,
+                           threshold=int(thr), live_arrays=arrs)
+            elif not self._armed and n < 0.9 * thr:
+                self._armed = True
+        return n
+
+
+class ProfileSession:
+    """One ``profile_start`` .. ``profile_stop`` window on a node: a
+    baseline snapshot of the phase histograms at start, a delta
+    breakdown at stop.
+
+    The phase histograms are cumulative (they feed stats/obs_push for
+    the process lifetime); the session subtracts its start snapshot so
+    the reply prices exactly the profiled window.  Window percentiles
+    are not derivable from two cumulative snapshots — the reply carries
+    per-phase ``count``/``sum_s``/``mean_ms`` (exact over the window)
+    and the cumulative p50 for context."""
+
+    def __init__(self, hists: dict, *, processed=None,
+                 jax_trace_dir: str | None = None):
+        #: name -> LatencyHistogram | None (absent phases stay None)
+        self._hists = dict(hists)
+        self._processed = processed  # callable -> int, or None
+        self._jax_trace_dir = jax_trace_dir
+        self._jax_tracing = False
+        self._t0: float | None = None
+        self._base: dict | None = None
+
+    @staticmethod
+    def _snap(h) -> tuple[int, float]:
+        if h is None:
+            return 0, 0.0
+        s = h.summary()
+        return int(s.get("count", 0)), float(s.get("sum", 0.0))
+
+    def start(self) -> dict:
+        if self._t0 is not None:
+            raise RuntimeError("profile session already started")
+        watcher = recompile_watcher().install()
+        self._base = {name: self._snap(h)
+                      for name, h in self._hists.items()}
+        self._base_compiles = watcher.count
+        self._base_processed = (self._processed()
+                                if self._processed else 0)
+        self._t0 = time.perf_counter()
+        if self._jax_trace_dir:
+            try:
+                import jax
+                jax.profiler.start_trace(self._jax_trace_dir)
+                self._jax_tracing = True
+            except Exception as e:  # noqa: BLE001 — backend without a
+                # profiler must not fail the session; the phase
+                # breakdown still answers
+                print(f"profile: jax.profiler.trace unavailable "
+                      f"({e!r})", file=sys.stderr, flush=True)
+        return {"t0_unix": time.time()}
+
+    def stop(self) -> dict:
+        if self._t0 is None:
+            raise RuntimeError("profile session never started")
+        dt = time.perf_counter() - self._t0
+        if self._jax_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 — symmetric guard
+                print(f"profile: stop_trace failed ({e!r})",
+                      file=sys.stderr, flush=True)
+        watcher = recompile_watcher()
+        phases = {}
+        for name, h in self._hists.items():
+            c1, s1 = self._snap(h)
+            c0, s0 = self._base[name]
+            dc, ds = c1 - c0, s1 - s0
+            phases[name] = {
+                "count": dc,
+                "sum_s": round(ds, 6),
+                "mean_ms": round(ds / dc * 1e3, 4) if dc else None,
+                "p50_ms_cum": (round(float(h.summary().get(
+                    "p50", 0.0)) * 1e3, 4) if h is not None else None),
+            }
+        doc = {
+            "duration_s": round(dt, 6),
+            "phases": phases,
+            "recompiles": watcher.count - self._base_compiles,
+            "mem_bytes": device_memory_bytes(),
+            "jax_trace_dir": (self._jax_trace_dir
+                              if self._jax_tracing else None),
+        }
+        if self._processed is not None:
+            doc["processed"] = (self._processed()
+                                - self._base_processed)
+        self._t0 = None
+        return doc
+
+
+_WATCHER: RecompileWatcher | None = None
+_MEM: MemoryWatcher | None = None
+_LOCK = threading.Lock()
+
+
+def recompile_watcher() -> RecompileWatcher:
+    """This process's recompile watcher (NOT auto-installed: call
+    ``.install()`` to hook jax.monitoring)."""
+    global _WATCHER
+    with _LOCK:
+        if _WATCHER is None:
+            _WATCHER = RecompileWatcher()
+        return _WATCHER
+
+
+def memory_watcher() -> MemoryWatcher:
+    global _MEM
+    with _LOCK:
+        if _MEM is None:
+            _MEM = MemoryWatcher()
+        return _MEM

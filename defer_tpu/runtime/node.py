@@ -203,6 +203,22 @@ class StageNode:
     #: gone).  Instance copy for the same attribution reason as
     #: ``infer_hist``; ``node.host_sync_s`` is the registry twin.
     host_sync_hist: LatencyHistogram | None = None
+    #: per-NODE phase histograms (docs/OBSERVABILITY.md §Profiling) —
+    #: the X-ray of the opaque ``infer`` interval: ``disp_hist`` times
+    #: the jit call RETURNING (host-side dispatch cost; jax queues the
+    #: compute and returns), ``queue_hist`` times the frame's residency
+    #: in the async in-flight window (dispatch return -> its drain
+    #: turn), ``dev_hist`` times ``block_until_ready`` (device
+    #: compute).  Together with ``host_sync_hist`` the four phases tile
+    #: the frame: dispatch + queue + device + host_sync ≈ infer
+    #: (scripts/profile_smoke.py asserts the sum).  Registry twins:
+    #: ``node.dispatch_s`` / ``node.queue_s`` / ``node.device_s``.
+    disp_hist: LatencyHistogram | None = None
+    queue_hist: LatencyHistogram | None = None
+    dev_hist: LatencyHistogram | None = None
+    #: active profile_start session (obs/profile.py); None between
+    #: sessions — the double-start refusal's state
+    _profile = None
     #: per-subscriber watermark splitter (class default covers
     #: ``__new__``-built stubs; created lazily under ``_WM_LOCK``)
     _wm_split: WatermarkSplit | None = None
@@ -294,6 +310,10 @@ class StageNode:
         self._live_tx = None
         self.infer_hist = LatencyHistogram()
         self.host_sync_hist = LatencyHistogram()
+        self.disp_hist = LatencyHistogram()
+        self.queue_hist = LatencyHistogram()
+        self.dev_hist = LatencyHistogram()
+        self._profile = None
         #: live obs_push reporter threads (one per subscription)
         self._reporters: list[ObsReporter] = []
 
@@ -369,23 +389,29 @@ class StageNode:
         import jax
         return jax.devices()[self.device]
 
-    def _host_sync(self, y, seq=None):
+    def _host_sync(self, y, seq=None, t0=None):
         """Materialize one stage output to host memory (``np.asarray``
         — the D2H sync every non-device-resident hop pays), timed into
         the per-node ``host_sync_hist`` + the registry twin and
         recorded as a ``stageK.host_sync`` span.  Device-resident (ici)
         hops never call this, so their zero sample count is the
-        observable proof the host round-trip is gone."""
-        sync = getattr(y, "block_until_ready", None)
-        if sync is not None:
-            # finish the (async-dispatched) device compute FIRST: this
-            # histogram prices the host materialization the planner's
-            # host_sync term models — folding compute wait into it
-            # would mis-calibrate host_sync_bw_s by orders of magnitude
-            sync()
-        t0 = time.perf_counter()
+        observable proof the host round-trip is gone.
+
+        ``t0`` (the previous phase's end timestamp, when given) chains
+        the phase windows end-to-start so the X-ray tiles the frame —
+        a fresh clock read per phase would leak each site's own
+        recording overhead into unaccounted gaps between phases.
+        Returns ``(out, t_end)``; the loops close the ``infer``
+        interval at ``t_end`` for the same reason."""
+        # finish the (async-dispatched) device compute FIRST — timed
+        # as the DEVICE phase: this histogram prices only the host
+        # materialization the planner's host_sync term models; folding
+        # compute wait into it would mis-calibrate host_sync_bw_s by
+        # orders of magnitude
+        t0 = self._device_wait(y, seq=seq, t0=t0)
         out = np.asarray(y)
-        dt = time.perf_counter() - t0
+        t_end = time.perf_counter()
+        dt = t_end - t0
         REGISTRY.histogram("node.host_sync_s").record(dt)
         if self.host_sync_hist is not None:
             self.host_sync_hist.record(dt)
@@ -393,7 +419,90 @@ class StageNode:
         if tr.enabled and _sampled(self.trace_sample_every, seq):
             tr.record(f"{self._span_label()}.host_sync", t0, dt,
                       {} if seq is None else {"seq": seq})
-        return out
+        # (out, phase end): the caller closes the infer interval at
+        # t_end, not a fresh clock read — otherwise THIS site's own
+        # recording cost (worst with every-frame spans) leaks into
+        # infer but no phase, and the tiling invariant drifts on
+        # microsecond-scale stages
+        return out, t_end
+
+    def _dispatch(self, *xs, seq=None):
+        """Run the stage program and time the DISPATCH phase — the jit
+        call returning, i.e. host-side tracing/queueing cost only (jax
+        dispatches asynchronously; the compute itself lands in the
+        DEVICE phase at sync time).  Returns ``(t0, y)`` with ``t0``
+        the dispatch start, which stays the anchor the loops measure
+        the issue-to-materialize ``infer`` interval from.  A dispatch
+        p50 near the infer p50 means the frame is HOST-bound — the
+        MPK/persistent-program evidence this plane exists to surface.
+
+        Returns ``(t0, t_end, y)``: ``t0`` stays the anchor the loops
+        measure the issue-to-materialize ``infer`` interval from, and
+        ``t_end`` seeds the QUEUE phase (:meth:`_queue_wait`) so the
+        four phases tile the interval exactly."""
+        t0 = time.perf_counter()
+        y = self.prog(*xs)
+        t_end = time.perf_counter()
+        dt = t_end - t0
+        REGISTRY.histogram("node.dispatch_s").record(dt)
+        if self.disp_hist is not None:
+            self.disp_hist.record(dt)
+        tr = tracer()
+        if tr.enabled and _sampled(self.trace_sample_every, seq):
+            tr.record(f"{self._span_label()}.dispatch", t0, dt,
+                      {} if seq is None else {"seq": seq})
+        return t0, t_end, y
+
+    def _queue_wait(self, t_end, seq=None):
+        """Time from the dispatch returning to this frame's drain turn,
+        recorded as the QUEUE phase — the frame's residency in the
+        async in-flight window (``pending``) while OLDER frames sync
+        and newer ones dispatch.  This is the overlap actually working:
+        a large queue share on a non-bottleneck stage is hidden
+        latency, not lost time.  The serial loop records it too (it is
+        ~0 there), so dispatch + queue + device + host_sync tiles the
+        ``infer`` interval on every loop and the profile plane's
+        phase-sum invariant holds everywhere.  Returns the phase's end
+        timestamp — pass it as the next phase's ``t0`` so the windows
+        chain without leaking recording overhead between them."""
+        t_now = time.perf_counter()
+        dt = t_now - t_end
+        REGISTRY.histogram("node.queue_s").record(dt)
+        if self.queue_hist is not None:
+            self.queue_hist.record(dt)
+        tr = tracer()
+        if tr.enabled and _sampled(self.trace_sample_every, seq):
+            tr.record(f"{self._span_label()}.queue", t_end, dt,
+                      {} if seq is None else {"seq": seq})
+        return t_now
+
+    def _device_wait(self, y, seq=None, t0=None):
+        """``block_until_ready`` timed as the DEVICE phase: device
+        compute plus the queueing of whatever in-flight window sits
+        ahead of this frame.  No-op on plain host arrays.  Both host
+        hops (via :meth:`_host_sync`) and device-resident ici hops
+        (directly) pay this, so the DEV column is comparable across
+        tiers while host_sync keeps its ici-hops-record-zero proof.
+
+        ``t0`` chains from the previous phase's end (see
+        :meth:`_host_sync`); returns THIS phase's end timestamp (its
+        start when the array needs no sync) for the next window."""
+        sync = getattr(y, "block_until_ready", None)
+        if sync is None:
+            return t0 if t0 is not None else time.perf_counter()
+        if t0 is None:
+            t0 = time.perf_counter()
+        sync()
+        t_end = time.perf_counter()
+        dt = t_end - t0
+        REGISTRY.histogram("node.device_s").record(dt)
+        if self.dev_hist is not None:
+            self.dev_hist.record(dt)
+        tr = tracer()
+        if tr.enabled and _sampled(self.trace_sample_every, seq):
+            tr.record(f"{self._span_label()}.device", t0, dt,
+                      {} if seq is None else {"seq": seq})
+        return t_end
 
     def _make_tx(self, connect_timeout_s: float):
         """Open the downstream connection(s): one :class:`AsyncSender`,
@@ -662,9 +771,57 @@ class StageNode:
             tr._remote_parent = None
             self._pending_trace = None
             return True
+        if cmd == "profile_start":
+            # on-demand phase profiling (obs/profile.py): bracket a
+            # window; the matching profile_stop replies with the DELTA
+            # phase breakdown.  A double start is refused LOUDLY — an
+            # error reply, connection kept — because silently restarting
+            # would corrupt the first caller's window arithmetic.
+            from ..obs.profile import (ProfileSession, memory_watcher,
+                                       recompile_watcher)
+            if self._profile is not None:
+                send_ctrl(conn, {
+                    "cmd": "profile_err",
+                    "error": "profile session already active on this "
+                             "node (profile_stop it first)"})
+                return True
+            # session start marks warmup done: install the compile
+            # listener and arm the one-event-per-episode emitter, prime
+            # the memory gauge
+            recompile_watcher().install().arm()
+            memory_watcher().observe()
+            sess = ProfileSession(
+                {"dispatch": self.disp_hist, "queue": self.queue_hist,
+                 "device": self.dev_hist,
+                 "host_sync": self.host_sync_hist,
+                 "infer": self.infer_hist},
+                processed=lambda: self.processed,
+                jax_trace_dir=msg.get("jax_trace_dir") or None)
+            started = sess.start()
+            self._profile = sess
+            send_ctrl(conn, {"cmd": "profile_started",
+                             "node": self._span_label(), **started})
+            return True
+        if cmd == "profile_stop":
+            if self._profile is None:
+                send_ctrl(conn, {
+                    "cmd": "profile_err",
+                    "error": "no active profile session on this node "
+                             "(profile_start first)"})
+                return True
+            report = self._profile.stop()
+            self._profile = None
+            report["node"] = self._span_label()
+            mm = self.manifest
+            report["stage"] = None if mm is None else mm["index"]
+            report["replica"] = self.replica
+            send_ctrl(conn, {"cmd": "profile_report", "report": report})
+            return True
         if cmd == "stats":
             # chain observability: what this node is and has done — the
             # per-node view the reference never had (SURVEY §5 metrics)
+            from ..obs.profile import \
+                device_memory_bytes as _dev_mem_bytes
             m = self.manifest
             reg = REGISTRY
             tx_live = self._live_tx
@@ -722,6 +879,30 @@ class StageNode:
                     (self.host_sync_hist.summary()
                      if self.host_sync_hist is not None
                      else reg.histogram("node.host_sync_s").summary()),
+                # the infer X-ray (obs/profile.py): dispatch = the jit
+                # call returning (host cost), queue = in-flight window
+                # residency, device = block_until_ready — dispatch +
+                # queue + device + host_sync tiles the infer interval
+                "dispatch_s":
+                    (self.disp_hist.summary()
+                     if self.disp_hist is not None
+                     else reg.histogram("node.dispatch_s").summary()),
+                "queue_s":
+                    (self.queue_hist.summary()
+                     if self.queue_hist is not None
+                     else reg.histogram("node.queue_s").summary()),
+                "device_s":
+                    (self.dev_hist.summary()
+                     if self.dev_hist is not None
+                     else reg.histogram("node.device_s").summary()),
+                # compile/memory telemetry: XLA compilations observed
+                # in this process (0 until a profile session or an
+                # explicit recompile_watcher().install() hooks the
+                # listener) and live device-array bytes (None when jax
+                # never loaded here — a deploy-less relay stays cheap)
+                "recompiles": reg.counter("jax.compiles").value,
+                "mem_bytes": _dev_mem_bytes(),
+                "profiling": self._profile is not None,
                 # phase timing: per-frame recv+decode / encode+send
                 # seconds of the data channels, plus the per-CHANNEL
                 # codec-only costs — the live bottleneck estimate's
@@ -953,6 +1134,20 @@ class StageNode:
                                 if self.host_sync_hist is not None
                                 else reg.histogram(
                                     "node.host_sync_s").summary()),
+                # phase X-ray (obs/profile.py): the monitor's DISP/DEV
+                # columns next to HS50
+                "dispatch_s": (self.disp_hist.summary()
+                               if self.disp_hist is not None
+                               else reg.histogram(
+                                   "node.dispatch_s").summary()),
+                "queue_s": (self.queue_hist.summary()
+                            if self.queue_hist is not None
+                            else reg.histogram(
+                                "node.queue_s").summary()),
+                "device_s": (self.dev_hist.summary()
+                             if self.dev_hist is not None
+                             else reg.histogram(
+                                 "node.device_s").summary()),
                 "rx_s": reg.histogram("node.rx_s").summary(),
                 "tx_s": reg.histogram("node.tx_s").summary(),
                 "encode_s": (tx.enc.summary() if tx is not None
@@ -967,6 +1162,12 @@ class StageNode:
             # honest chip peak
             "capacity": self._capacity(),
         }
+        # compile/memory telemetry (obs/profile.py): observe() updates
+        # the device.mem_bytes gauge AND runs the mem_pressure
+        # threshold check — push cadence, never the frame hot path
+        from ..obs.profile import memory_watcher
+        payload["recompiles"] = reg.counter("jax.compiles").value
+        payload["mem_bytes"] = memory_watcher().observe()
         tr = tracer()
         trace_doc: dict = {"dropped": tr.dropped}
         if include_spans and tr.enabled:
@@ -1116,19 +1317,20 @@ class StageNode:
 
         def drain_one():
             nonlocal n, streamed
-            t0, s, y, relay_seq = pending.popleft()
+            t0, t_end, s, y, relay_seq = pending.popleft()
             inflight_g.dec()
+            tq = self._queue_wait(t_end, seq=relay_seq)
             if isinstance(tx, IciSender):
                 # device-resident mode: the downstream hop accepts live
                 # jax.Arrays, so the output is NEVER materialized to
                 # host — only synced (bounding the dispatch window as
                 # before).  Zero host_sync samples on this node is the
                 # observable proof the round-trip is gone.
-                y.block_until_ready()
+                t_done = self._device_wait(y, seq=relay_seq, t0=tq)
             else:
                 # host sync of the OLDEST in-flight output
-                y = self._host_sync(y, seq=relay_seq)
-            dt = time.perf_counter() - t0
+                y, t_done = self._host_sync(y, seq=relay_seq, t0=tq)
+            dt = t_done - t0
             infer_hist.record(dt)
             if self.infer_hist is not None:
                 self.infer_hist.record(dt)
@@ -1284,8 +1486,8 @@ class StageNode:
                         f"shape {want}, got {tuple(value.shape[1:])}")
                 if self.infer_delay_s:
                     time.sleep(self.infer_delay_s)  # bench-only device
-                t0 = time.perf_counter()
-                pending.append((t0, seq, self.prog(value), relay_seq))
+                t0, t_end, y_disp = self._dispatch(value, seq=relay_seq)
+                pending.append((t0, t_end, seq, y_disp, relay_seq))
                 seq += 1
                 inflight_g.inc()
                 while len(pending) >= self.inflight:
@@ -1409,9 +1611,10 @@ class StageNode:
                         f"shape {want}, got {tuple(value.shape[1:])}")
                 if self.infer_delay_s:
                     time.sleep(self.infer_delay_s)  # bench-only device
-                t0 = time.perf_counter()
-                y = self._host_sync(self.prog(value), seq=relay_seq)
-                dt = time.perf_counter() - t0
+                t0, t_end, y = self._dispatch(value, seq=relay_seq)
+                tq = self._queue_wait(t_end, seq=relay_seq)
+                y, t_done = self._host_sync(y, seq=relay_seq, t0=tq)
+                dt = t_done - t0
                 infer_hist.record(dt)
                 if self.infer_hist is not None:
                     self.infer_hist.record(dt)
@@ -1665,16 +1868,17 @@ class StageNode:
 
         def drain_one():
             nonlocal n
-            t0, s, y = pending.popleft()
+            t0, t_end, s, y = pending.popleft()
             inflight_g.dec()
+            tq = self._queue_wait(t_end)
             if isinstance(tx, IciSender):
                 # the merge node's OUTBOUND hop can legitimately win
                 # ici (only its inbound fan is wire-framed): keep the
                 # output device-resident, zero host_sync samples
-                y.block_until_ready()
+                t_done = self._device_wait(y, t0=tq)
             else:
-                y = self._host_sync(y)
-            dt = time.perf_counter() - t0
+                y, t_done = self._host_sync(y, t0=tq)
+            dt = t_done - t0
             infer_hist.record(dt)
             if self.infer_hist is not None:
                 self.infer_hist.record(dt)
@@ -1739,8 +1943,8 @@ class StageNode:
                         f"shape {want}, got {tuple(value.shape[1:])}")
                 if self.infer_delay_s:
                     time.sleep(self.infer_delay_s)  # bench-only device
-                t0 = time.perf_counter()
-                pending.append((t0, seq, self.prog(value)))
+                t0, t_end, y_disp = self._dispatch(value)
+                pending.append((t0, t_end, seq, y_disp))
                 seq += 1
                 inflight_g.inc()
                 if self.failover and seq % ACK_EVERY == 0:
@@ -1881,15 +2085,16 @@ class StageNode:
 
         def drain_one():
             nonlocal n
-            t0, s, y = pending.popleft()
+            t0, t_end, s, y = pending.popleft()
             inflight_g.dec()
+            tq = self._queue_wait(t_end, seq=s)
             if isinstance(tx, IciSender):
                 # a join node's outbound hop can win ici too — only
                 # the P inbound paths are wire-framed
-                y.block_until_ready()
+                t_done = self._device_wait(y, seq=s, t0=tq)
             else:
-                y = self._host_sync(y, seq=s)
-            dt = time.perf_counter() - t0
+                y, t_done = self._host_sync(y, seq=s, t0=tq)
+            dt = t_done - t0
             infer_hist.record(dt)
             if self.infer_hist is not None:
                 self.infer_hist.record(dt)
@@ -1951,8 +2156,8 @@ class StageNode:
                             f"{tuple(part.shape[1:])}")
                 if self.infer_delay_s:
                     time.sleep(self.infer_delay_s)
-                t0 = time.perf_counter()
-                pending.append((t0, seq, self.prog(*parts)))
+                t0, t_end, y_disp = self._dispatch(*parts, seq=seq)
+                pending.append((t0, t_end, seq, y_disp))
                 inflight_g.inc()
                 while len(pending) >= self.inflight:
                     drain_one()

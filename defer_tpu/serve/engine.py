@@ -145,6 +145,18 @@ class ContinuousBatchEngine:
         self.steps = 0
         self._step_hist = REGISTRY.histogram("serve.decode.step_s")
         self._tok_count = REGISTRY.counter("serve.decode.tokens")
+        # per-step phase decomposition (obs/profile.py ENGINE_PHASES):
+        # gather (host build of the per-slot rows / teacher-forcing),
+        # dispatch (the jit step call returning), device
+        # (block_until_ready — the fused step program: blocks, lm_head,
+        # sampling AND the KV write all live here; splitting those
+        # needs jax.profiler), sync (np.asarray of the sampled ids),
+        # delivery (per-slot bookkeeping + on_done).  step_s stays the
+        # dispatch→materialize total the serve stats already report.
+        self._phase_hists = {
+            name: REGISTRY.histogram(f"serve.decode.{name}_s")
+            for name in ("gather", "dispatch", "device", "sync",
+                         "delivery")}
 
     # -- state -------------------------------------------------------------
 
@@ -256,6 +268,8 @@ class ContinuousBatchEngine:
         live = [(i, s) for i, s in enumerate(self._slots) if s is not None]
         if not live:
             return []
+        ph = self._phase_hists
+        t_gather = time.perf_counter()
         w = self.width
         ids = np.zeros(w, np.int32)
         pos = np.zeros(w, np.int32)
@@ -270,11 +284,21 @@ class ContinuousBatchEngine:
             temps[i] = s.req.temperature
             sample = sample or s.req.temperature > 0
         t0 = time.perf_counter()
+        ph["gather"].record(t0 - t_gather)
         next_ids, self._caches = self._step_fn(sample)(
             self.params, self._caches, jnp.asarray(ids), jnp.asarray(pos),
             jnp.asarray(seeds), jnp.asarray(temps))
+        t_disp = time.perf_counter()
+        ph["dispatch"].record(t_disp - t0)
+        sync = getattr(next_ids, "block_until_ready", None)
+        if sync is not None:
+            sync()
+        t_dev = time.perf_counter()
+        ph["device"].record(t_dev - t_disp)
         next_ids = np.asarray(next_ids)
-        dt = time.perf_counter() - t0
+        t_sync = time.perf_counter()
+        ph["sync"].record(t_sync - t_dev)
+        dt = t_sync - t0
         self._step_hist.record(dt)
         self.steps += 1
         done: list[tuple[DecodeRequest, np.ndarray]] = []
@@ -296,6 +320,7 @@ class ContinuousBatchEngine:
                 done.append((s.req, result))
                 if s.req.on_done is not None:
                     s.req.on_done(result)
+        ph["delivery"].record(time.perf_counter() - t_sync)
         return done
 
     # -- convenience (tests, sequential baselines) -------------------------

@@ -259,17 +259,37 @@ def test_fanout_heals_dead_channel_and_replays_unacked():
             fo.send(x)
         fo.send_end()
         fo.close(timeout=15.0)
+        # close() joins the SEND side; the reader thread may still be
+        # draining buffered frames — join it before asserting on them
+        t2.join(timeout=10.0)
+        assert not t2.is_alive(), "replica reader never saw END"
         assert fo.failovers == 1
         # new connection saw the replayed window (2, 3) then the rest,
-        # stamped with their original seqs — no renumbering
+        # stamped with their original seqs — no renumbering.  The wire
+        # MAY carry one duplicate of the first post-heal frame: when
+        # send(4) is what detects the dead channel, frame 4 is already
+        # retained when the heal snapshots unacked(), so the heal
+        # replays it AND the send retry re-sends it (the documented
+        # contract — ReplayFanOut.send(): the downstream merge dedups
+        # inside its replay window).  Dedup like the merge does;
+        # order must still be non-decreasing originals.
         seqs = [int(seq) for seq, _ in second]
-        assert seqs == [2, 3] + list(range(4, 10))
+        deduped: list = []
+        for q in seqs:
+            if deduped and q == deduped[-1]:
+                continue            # heal-vs-retry duplicate
+            assert not deduped or q > deduped[-1], \
+                f"out-of-order replay: {seqs}"
+            deduped.append(q)
+        assert deduped == [2, 3] + list(range(4, 10))
         for seq, arr in second:
             np.testing.assert_array_equal(arr, xs[int(seq)])
         evs = [e for e in recorder().snapshot()
                if e["kind"] == "failover"
                and e["data"].get("addr") == f"127.0.0.1:{port}"]
-        assert evs and evs[-1]["data"]["replayed"] == 2
+        # replayed = the unacked window at snapshot time: frames 2, 3
+        # plus frame 4 iff the racing send retained it first
+        assert evs and evs[-1]["data"]["replayed"] in (2, 3)
         assert evs[-1]["data"]["recovery_ms"] > 0
     finally:
         srv.close()
