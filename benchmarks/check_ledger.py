@@ -5,10 +5,11 @@
 script reads that trajectory and flags REGRESSIONS: for every metric,
 each successful row is compared against the PREVIOUS successful row of
 the same metric, and a drop of more than ``--threshold`` (fraction,
-default 0.30) is a regression.  Higher-is-better is assumed — every
-ledger metric today is a throughput (inf/s, tokens/sec) or a ratio
-where bigger means healthier; a metric whose polarity flips must grow
-an entry in ``LOWER_IS_BETTER`` below, not a silent sign hack.
+default 0.30) is a regression.  Higher-is-better is assumed by default
+(throughputs, ratios where bigger means healthier); metrics whose
+polarity flips — recovery times, overhead fractions, error fractions —
+must grow an entry in ``LOWER_IS_BETTER`` below, not a silent sign
+hack.
 
 Failure rows (``status: "failed"``) are reported but never compared —
 a run that did not measure cannot regress, and the NEXT successful row
@@ -18,15 +19,24 @@ Exit codes:
   0  no regressions (including: ledger missing, empty, or every metric
      has fewer than two successful rows — a short history is not a
      failure, it is the absence of a trend)
-  1  at least one regression past the threshold
+  1  at least one regression past the threshold — with ``--fail-on``,
+     only regressions on the NAMED metrics flip the exit code (the
+     rest stay warnings in the log)
 
-CI runs this warn-only (``continue-on-error``): the ledger in a fresh
-checkout is usually absent, and a genuine regression should page a
-human via the log, not mask an unrelated PR.
+CI runs the all-metrics sweep warn-only (``continue-on-error``): the
+ledger in a fresh checkout is usually absent, and a genuine regression
+should page a human via the log, not mask an unrelated PR.  On top of
+that, ``--fail-on METRIC:PCT`` (repeatable) promotes specific metrics
+to build-failing gates at their own per-metric thresholds — CI
+enforces ``pipeline_failover`` recovery time and the observability
+overhead rows this way, so those regressions fail the build instead of
+scrolling past.  ``row=METRIC:PCT`` is accepted as an alias spelling.
 
 Usage:
   python benchmarks/check_ledger.py
   python benchmarks/check_ledger.py --threshold 0.15 --ledger path.jsonl
+  python benchmarks/check_ledger.py --fail-on pipeline_failover:1.0 \
+      --fail-on obs_overhead:2.0
 """
 
 from __future__ import annotations
@@ -36,8 +46,18 @@ import json
 import os
 import sys
 
-#: metrics where a DROP is an improvement (none today; see module doc)
-LOWER_IS_BETTER: frozenset = frozenset()
+#: metrics where a DROP is an improvement: recovery times (ms),
+#: instrumentation overhead fractions, and prediction-error fractions —
+#: for these an INCREASE is the regression
+LOWER_IS_BETTER: frozenset = frozenset({
+    "pipeline_failover",      # value = ms recovery
+    "obs_overhead",           # value = frac wall overhead vs no trace
+    "profile_overhead",       # value = frac wall overhead vs no session
+    "blackbox_overhead",      # value = frac wall overhead vs no journal
+    "cost_model_truth",       # value = frac abs err of the calibrated
+                              # bottleneck prediction
+    "request_attribution",    # value = frac residual p99
+})
 
 
 def log(*a):
@@ -66,8 +86,45 @@ def load_rows(path: str) -> list:
     return rows
 
 
-def check(rows: list, threshold: float) -> list:
-    """Return the list of regression records (possibly empty)."""
+def parse_fail_on(specs: list) -> dict:
+    """Parse repeatable ``--fail-on`` specs into ``{metric: frac}``.
+
+    Accepted spellings: ``metric:pct`` and ``row=metric:pct``.  The pct
+    is a fraction (``0.5`` = 50%, ``2.0`` = 200% for noisy rows);
+    values >= 5 are read as whole percent (``50`` = 0.5) so both
+    conventions work without ambiguity.
+    """
+    enforced: dict = {}
+    for spec in specs:
+        body = spec[len("row="):] if spec.startswith("row=") else spec
+        metric, sep, pct = body.rpartition(":")
+        if not sep or not metric:
+            raise SystemExit(
+                f"check_ledger: bad --fail-on spec {spec!r} "
+                f"(want METRIC:PCT, e.g. pipeline_failover:1.0)")
+        try:
+            frac = float(pct)
+        except ValueError:
+            raise SystemExit(
+                f"check_ledger: bad --fail-on threshold in {spec!r}")
+        if frac >= 5.0:
+            frac = frac / 100.0
+        if frac <= 0:
+            raise SystemExit(
+                f"check_ledger: --fail-on threshold must be > 0 "
+                f"({spec!r})")
+        enforced[metric] = frac
+    return enforced
+
+
+def check(rows: list, threshold: float, enforced: dict | None = None) -> list:
+    """Return the list of regression records (possibly empty).
+
+    ``enforced`` maps metric -> per-metric threshold fraction; those
+    metrics are gated at their own threshold and their regression
+    records carry ``enforced: True``.
+    """
+    enforced = enforced or {}
     last_ok: dict = {}          # metric -> (value, run_unix)
     regressions = []
     for row in rows:
@@ -91,12 +148,15 @@ def check(rows: list, threshold: float) -> list:
         delta = (float(value) - prev_value) / abs(prev_value)
         if metric in LOWER_IS_BETTER:
             delta = -delta
-        if delta < -threshold:
+        gate = enforced.get(metric, threshold)
+        if delta < -gate:
             regressions.append({
                 "metric": metric,
                 "prev": prev_value,
                 "value": float(value),
                 "drop_frac": round(-delta, 4),
+                "threshold": gate,
+                "enforced": metric in enforced,
                 "prev_run_unix": prev_run,
                 "run_unix": row.get("run_unix"),
             })
@@ -112,7 +172,15 @@ def main():
                     help="fractional drop vs the previous successful "
                          "row of the same metric that counts as a "
                          "regression (default 0.30)")
+    ap.add_argument("--fail-on", action="append", default=[],
+                    metavar="METRIC:PCT",
+                    help="promote METRIC to a build-failing gate at "
+                         "its own threshold (repeatable; fraction, or "
+                         "whole percent when >= 5). With any --fail-on "
+                         "given, ONLY those metrics flip the exit "
+                         "code — others remain log warnings.")
     args = ap.parse_args()
+    enforced = parse_fail_on(args.fail_on)
 
     if not os.path.exists(args.ledger):
         log(f"check_ledger: no ledger at {args.ledger} — nothing to "
@@ -123,20 +191,26 @@ def main():
         log("check_ledger: ledger is empty — nothing to gate")
         return 0
 
-    regressions = check(rows, args.threshold)
+    regressions = check(rows, args.threshold, enforced)
     n_metrics = len({r.get("metric") for r in rows
                      if r.get("metric") is not None})
     if not regressions:
+        gates = (f", {len(enforced)} enforced gate(s) clean"
+                 if enforced else "")
         log(f"check_ledger: OK — {len(rows)} row(s) across "
             f"{n_metrics} metric(s), no drop past "
-            f"{args.threshold:.0%}")
+            f"{args.threshold:.0%}{gates}")
         return 0
     for r in regressions:
-        log(f"check_ledger: REGRESSION {r['metric']}: "
+        tag = "REGRESSION" if r["enforced"] or not enforced else "warning"
+        log(f"check_ledger: {tag} {r['metric']}: "
             f"{r['prev']} -> {r['value']} "
-            f"(-{r['drop_frac']:.1%}, threshold {args.threshold:.0%})")
+            f"(-{r['drop_frac']:.1%}, threshold {r['threshold']:.0%})")
     print(json.dumps({"regressions": regressions,
-                      "threshold": args.threshold}))
+                      "threshold": args.threshold,
+                      "fail_on": enforced}))
+    if enforced:
+        return 1 if any(r["enforced"] for r in regressions) else 0
     return 1
 
 

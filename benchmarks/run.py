@@ -359,6 +359,15 @@ def run_script_row(script_name: str, extra_argv: list | None = None):
 #: EXACTLY ceil(num_steps/chunk_steps) scan dispatches and a dispatch
 #: share <= ~1 of the generation wall; the guard rail under the mb64
 #: decode-cliff autopsy in docs/DECODE_CLIFF.md)
+#: ... and `blackbox_overhead` (the flight-recorder black box: the
+#: chaos row's kill -9 replayed with --journal-dir on every process,
+#: then the postmortem re-assembled OFFLINE from nothing but the
+#: on-disk journals — verdict must name the killed replica with
+#: journal-stop evidence, rank the nearest downstream stage first
+#: among casualties, and show no negative inter-process gap on the
+#: anchor-aligned timeline; the row's value is the journaling wall
+#: tax from the interleaved min-of-3 on/off protocol, asserted < 5% —
+#: docs/OBSERVABILITY.md "Black box & postmortem")
 SCRIPT_ROWS = {
     "chain_overlap": "chain_overlap_smoke.py",
     "pipeline_failover": "chaos_smoke.py",
@@ -373,6 +382,7 @@ SCRIPT_ROWS = {
     "dag_pipeline": "dag_smoke.py",
     "cost_model_truth": "capacity_smoke.py",
     "decode_profile": "decode_profile_smoke.py",
+    "blackbox_overhead": "postmortem_smoke.py",
 }
 
 

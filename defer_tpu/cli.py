@@ -673,6 +673,28 @@ def cmd_node(args):
                else kv.get("tier", args.tier) != "tcp", False,
                kv.get("device"))
           for kv in map(_parse_co_stage, args.co_stage or [])]
+    if args.journal_dir:
+        # black-box flight recorder (docs/OBSERVABILITY.md): spill this
+        # process's events + obs rows + spans to a crash-safe journal a
+        # postmortem can read after a kill -9
+        from .obs import recorder, start_journal
+        m = node.manifest
+        label = (f"stage{m['index']}" if m is not None
+                 else f"node{node.address[1]}")
+        if args.replica is not None:
+            label += f".r{args.replica}"
+
+        def _journal_row(_node=node):
+            payload, _, _ = _node.obs_snapshot(
+                include_spans=False, subscriber=-101,
+                event_cursor=recorder().cursor())
+            # events/spans ride their own journal records; the snapshot
+            # is the last-known ClusterView-style row
+            payload.pop("trace", None)
+            payload.pop("events", None)
+            return payload
+
+        start_journal(args.journal_dir, label, snapshot_fn=_journal_row)
     counts: dict[int, int] = {}
 
     def serve_co(i: int):
@@ -699,6 +721,9 @@ def cmd_node(args):
     for t in threads:
         t.join()
     n += sum(counts.values())
+    if args.journal_dir:
+        from .obs import stop_journal
+        stop_journal()   # final spill: the clean-exit journal is whole
     print(f"node: served {n} tensors; chain drained", file=sys.stderr)
 
 
@@ -860,7 +885,8 @@ def cmd_chain(args):
                      devices=args.devices, device_map=device_map,
                      stats_out=stats,
                      trace_sample_every=args.trace_sample,
-                     failover=args.failover)
+                     failover=args.failover,
+                     journal_dir=args.journal_dir or None)
     dt = time.perf_counter() - t0
 
     fwd = jax.jit(graph.apply)
@@ -1143,6 +1169,16 @@ def cmd_serve(args):
                               tenants=tenants,
                               gather_s=args.gather_ms / 1e3)
     door.start()
+    if args.journal_dir:
+        # the front door is a fleet member too: its admission/shed
+        # events and pressure snapshots belong in the black box
+        from .obs import start_journal
+
+        def _serve_row(_door=door):
+            return {"pressure": _door.pressure(),
+                    "stats": _door.stats()}
+
+        start_journal(args.journal_dir, "serve", snapshot_fn=_serve_row)
     print(json.dumps({"serving": f"{door.address[0]}:{door.address[1]}",
                       "mode": door.mode, "width": door.width,
                       "model": args.model, "stages": args.stages}),
@@ -1159,6 +1195,16 @@ def cmd_serve(args):
             door.healthcheck()
     except KeyboardInterrupt:
         pass
+    except BaseException as e:
+        if args.journal_dir:
+            # a dead backend/engine is exactly what the black box is
+            # for: final-spill, then bundle synchronously before dying
+            from .obs import maybe_autopsy, stop_journal
+            stop_journal()
+            maybe_autopsy(f"serve: {type(e).__name__}: {e}",
+                          journal_dir=args.journal_dir, sync=True,
+                          delay_s=0.0)
+        raise
     finally:
         from .obs import tracer
         if tracer().enabled and ext_addrs:
@@ -1172,8 +1218,35 @@ def cmd_serve(args):
                       file=sys.stderr, flush=True)
         door.stop()
         cleanup()
+        if args.journal_dir:
+            from .obs import stop_journal
+            stop_journal()
         _obs_finish(args)
         print(json.dumps({"final_stats": door.stats()}), flush=True)
+
+
+def cmd_postmortem(args):
+    """Assemble a forensics bundle from the on-disk black-box journals
+    under a ``--journal-dir`` — no live process required; the journals
+    of dead (kill -9'd) processes are the whole point
+    (docs/OBSERVABILITY.md, "Black box & postmortem")."""
+    from .obs import collect_postmortem
+
+    bundle = collect_postmortem(args.dir, out_dir=args.out or None,
+                                reason=args.reason, last_s=args.last_s)
+    for w in bundle["warnings"]:
+        print(f"postmortem: WARNING: {w}", file=sys.stderr, flush=True)
+    verdict = bundle["verdict"] or {}
+    print(json.dumps({
+        "out_dir": bundle["out_dir"],
+        "procs": [p["proc"] for p in bundle["procs"]],
+        "events": len(bundle["timeline"]),
+        "events_dropped": bundle["events_dropped"],
+        "warnings": len(bundle["warnings"]),
+        "first_fault": verdict.get("first_fault"),
+        "evidence": verdict.get("evidence"),
+        "casualties": [c["proc"] for c in verdict.get("casualties", [])],
+    }, default=str), flush=True)
 
 
 def cmd_serve_client(args):
@@ -1304,11 +1377,18 @@ def cmd_monitor(args):
                                    sustain=args.sustain)
     view = ClusterView()
     if addrs:
+        # follow mode survives node restarts: the failover supervisor
+        # respawns a killed replica on its old port, so the reader
+        # redials with connect_retry's jittered backoff instead of
+        # exiting on the first dead socket (merge_events below dedups
+        # any resumed-stream overlap on the (proc, seq) key)
         view.connect(addrs, interval_ms=args.interval_ms,
                      align_clocks=args.align,
-                     timeout_s=args.connect_timeout)
+                     timeout_s=args.connect_timeout,
+                     reconnect=follow)
     door_ev_cursor = 0
     door_ev_dropped = 0
+    last_dropped = 0
     try:
         i = 0
         while True:
@@ -1354,6 +1434,14 @@ def cmd_monitor(args):
                         print(f"{ev['t_us'] / 1e6:16.6f} "
                               f"[{ev['kind']:>14}] {ev['proc']}"
                               f"#{ev['seq']} {data}", flush=True)
+                # evidence-gap footer: a tail with ring evictions is
+                # NOT the whole story — say so when the count grows
+                dropped = view.events_dropped + door_ev_dropped
+                if dropped > last_dropped:
+                    print(f"event: WARNING {dropped} events dropped "
+                          f"ring-wide — the merged log has gaps "
+                          f"(raise DEFER_EVENTS_CAP)", flush=True)
+                    last_dropped = dropped
                 if args.iterations and i >= args.iterations:
                     return
                 continue
@@ -1411,10 +1499,15 @@ def cmd_monitor(args):
                                         sorted(ev["data"].items()))
                         print(f"event: [{ev['kind']}] {ev['proc']}"
                               f"#{ev['seq']} {data}")
+                if args.events:
+                    # evidence-gap footer rides EVERY --events refresh
+                    # (not only ticks that happened to render events):
+                    # a nonzero total means the merged log has holes
                     dropped = view.events_dropped + door_ev_dropped
                     if dropped:
                         print(f"event: ({dropped} dropped ring-wide — "
-                              f"raise DEFER_EVENTS_CAP)")
+                              f"merged log has gaps; raise "
+                              f"DEFER_EVENTS_CAP)")
                 if serve_doc is not None:
                     _render_serve_stats(serve_doc)
                 if suggestion is not None:
@@ -1838,6 +1931,14 @@ def main(argv=None):
                          "otherwise; accept gates inbound offers, "
                          "default: tier != tcp; device pins the "
                          "housemate's program to jax device J)")
+    nd.add_argument("--journal-dir", default="", metavar="DIR",
+                    help="black-box flight recorder: spill this "
+                         "process's events, obs-row snapshots, and "
+                         "sampled spans to a crash-safe on-disk journal "
+                         "under DIR (segment ring, per-record CRC, "
+                         "clock anchors) readable by `defer_tpu "
+                         "postmortem DIR` after any death "
+                         "(docs/OBSERVABILITY.md)")
     _add_overlap_flags(nd)
 
     c = sub.add_parser("chain", help="spawn a local N-process chain and "
@@ -1929,6 +2030,13 @@ def main(argv=None):
                         "telemetry and write the versioned JSON "
                         "artifact — feed it back via `plan "
                         "--calibrated` (docs/PLANNER.md)")
+    c.add_argument("--journal-dir", default="", metavar="DIR",
+                   help="black-box flight recorder: every stage "
+                        "process AND the dispatcher journal their "
+                        "telemetry under DIR; a failover respawn or "
+                        "chain failure auto-emits a postmortem bundle "
+                        "with a first-fault verdict, and `defer_tpu "
+                        "postmortem DIR` does it on demand")
     _add_overlap_flags(c)
     _add_obs_flags(c)
 
@@ -1991,6 +2099,11 @@ def main(argv=None):
                          "across the front door and every stage "
                          "process, on one clock-aligned timeline "
                          "(docs/OBSERVABILITY.md)")
+    sv.add_argument("--journal-dir", default="", metavar="DIR",
+                    help="black-box flight recorder: journal the front "
+                         "door's events and pressure snapshots under "
+                         "DIR; a failed healthcheck auto-emits a "
+                         "postmortem bundle (docs/OBSERVABILITY.md)")
     _add_obs_flags(sv)
     _add_cost_flags(sv)
 
@@ -2089,6 +2202,23 @@ def main(argv=None):
                          "spans the dispatcher already aligned)")
     mo.add_argument("--connect-timeout", type=float, default=30.0)
 
+    pm = sub.add_parser("postmortem",
+                        help="assemble a forensics bundle (merged "
+                             "timeline, Perfetto trace, last-known "
+                             "rows, first-fault verdict) from the "
+                             "black-box journals under a --journal-dir "
+                             "— works on dead processes")
+    pm.add_argument("dir", metavar="JOURNAL_DIR",
+                    help="the --journal-dir a node/chain/serve wrote")
+    pm.add_argument("--out", default="", metavar="DIR",
+                    help="bundle output directory (default: a "
+                         "bundle-<stamp> dir inside JOURNAL_DIR)")
+    pm.add_argument("--last-s", type=float, default=30.0,
+                    help="Perfetto window: keep the final N seconds "
+                         "of spans/events in trace.json")
+    pm.add_argument("--reason", default="manual",
+                    help="reason recorded in the bundle")
+
     pr = sub.add_parser("profile", help="attach to a running chain for "
                                         "N seconds: per-stage phase "
                                         "breakdown (dispatch/device/"
@@ -2162,6 +2292,7 @@ def main(argv=None):
      "chain": cmd_chain, "monitor": cmd_monitor, "train": cmd_train,
      "generate": cmd_generate, "serve": cmd_serve,
      "serve-client": cmd_serve_client,
+     "postmortem": cmd_postmortem,
      "profile": cmd_profile}[args.cmd](args)
 
 

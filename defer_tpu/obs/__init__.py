@@ -33,6 +33,11 @@ from .cluster import (ClusterView, StragglerDetector, StragglerFlag,
 from .capacity import (CapacityModel, DriftAuditor, DriftFlag,
                        achieved_mfu, stage_flops_bytes)
 from .report import ObsReporter, start_prom_server
+from .journal import (JOURNAL_VERSION, JournalSpiller, JournalWriter,
+                      active_journal, read_journal,
+                      read_process_journals, start_journal, stop_journal)
+from .postmortem import (BUNDLE_VERSION, collect as collect_postmortem,
+                         maybe_autopsy)
 from .profile import (ENGINE_PHASES, NODE_PHASES, MemoryWatcher,
                       ProfileSession, RecompileWatcher,
                       device_memory_bytes, memory_watcher,
@@ -52,6 +57,10 @@ __all__ = [
     "CapacityModel", "DriftAuditor", "DriftFlag", "achieved_mfu",
     "stage_flops_bytes",
     "ObsReporter", "start_prom_server",
+    "JOURNAL_VERSION", "JournalWriter", "JournalSpiller",
+    "start_journal", "stop_journal", "active_journal",
+    "read_journal", "read_process_journals",
+    "BUNDLE_VERSION", "collect_postmortem", "maybe_autopsy",
     "NODE_PHASES", "ENGINE_PHASES", "ProfileSession",
     "RecompileWatcher", "recompile_watcher",
     "MemoryWatcher", "memory_watcher", "device_memory_bytes",

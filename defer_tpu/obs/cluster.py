@@ -225,11 +225,23 @@ class ClusterView:
                 spans: bool = False, span_limit: int = 256,
                 align_clocks: bool = False, probe_clocks: bool = True,
                 timeout_s: float = 30.0,
-                clock_rounds: int = 8) -> "ClusterView":
+                clock_rounds: int = 8,
+                reconnect: bool = False) -> "ClusterView":
         """Dial every node address, subscribe to its push stream, and
         consume pushes on one daemon reader thread per node until
         :meth:`close`.  A node that dies mid-watch marks its rows dead
         instead of killing the view.
+
+        ``reconnect=True`` makes each reader SURVIVE node restarts: the
+        failover supervisor respawns a killed replica on its old port,
+        so the reader redials that address with the transport's jittered
+        ``connect_retry`` backoff, re-subscribes, and resumes — the
+        follow-mode monitor keeps tailing across the kill instead of
+        going silent.  Resumed streams dedup naturally: a respawned
+        process's events carry a fresh ``proc`` identity and a fresh
+        subscription's cursor starts at its current ring position, and
+        the consumer-side ``merge_events`` collapses any overlap on the
+        ``(proc, seq)`` key.
 
         Clocks: ``probe_clocks`` (default) ESTIMATES each node's offset
         (filling :attr:`clock_offsets`) without touching its tracer —
@@ -241,6 +253,10 @@ class ClusterView:
         or ``monitor --align``)."""
         from ..transport.framed import send_ctrl
 
+        self._sub = {"interval_ms": interval_ms, "spans": bool(spans),
+                     "span_limit": int(span_limit)}
+        self._reconnect = bool(reconnect)
+        self._redial_timeout_s = float(timeout_s)
         for addr in addrs:
             host, _, port = str(addr).rpartition(":")
             sock = self._dial(host or "127.0.0.1", int(port), timeout_s)
@@ -268,26 +284,57 @@ class ClusterView:
         return connect_retry(host, port, timeout_s)
 
     def _reader(self, sock, addr: str) -> None:
-        from ..transport.framed import K_CTRL, K_END, recv_frame
-        try:
-            while not self._closed.is_set():
-                kind, msg = recv_frame(sock)
-                if kind == K_END:
+        from ..transport.framed import K_CTRL, K_END, recv_frame, send_ctrl
+        while True:
+            try:
+                while not self._closed.is_set():
+                    kind, msg = recv_frame(sock)
+                    if kind == K_END:
+                        return
+                    if kind == K_CTRL and isinstance(msg, dict) \
+                            and msg.get("cmd") == "obs_push":
+                        self.ingest(msg, addr)
+                return
+            except (OSError, ConnectionError, ValueError) as e:
+                with self._lock:
+                    for node in self._nodes.values():
+                        if node.addr == addr:
+                            node.err = e
+                if self._closed.is_set():
                     return
-                if kind == K_CTRL and isinstance(msg, dict) \
-                        and msg.get("cmd") == "obs_push":
-                    self.ingest(msg, addr)
-        except (OSError, ConnectionError, ValueError) as e:
-            with self._lock:
-                for node in self._nodes.values():
-                    if node.addr == addr:
-                        node.err = e
-            if not self._closed.is_set():
                 # a node dying mid-watch is itself a flight-recorder
                 # fact: it lands in THIS process's ring and therefore in
                 # the merged log (the dead node can no longer push)
                 self._events.append(emit_event(
                     "node_dead", addr=addr, error=repr(e)))
+                if not getattr(self, "_reconnect", False):
+                    return
+                # survive the restart: the failover supervisor respawns
+                # a killed replica on its OLD port, so redial the same
+                # address with the transport's jittered backoff and
+                # re-subscribe (a fresh subscription's event cursor
+                # starts at the new ring's position; merge_events dedups
+                # any overlap on (proc, seq))
+                host, _, port = addr.rpartition(":")
+                try:
+                    sock = self._dial(host or "127.0.0.1", int(port),
+                                      getattr(self, "_redial_timeout_s",
+                                              30.0))
+                    send_ctrl(sock, {"cmd": "obs_subscribe",
+                                     **self._sub})
+                except (OSError, ConnectionError):
+                    return   # node stayed dead past the dial deadline
+                if self._closed.is_set():
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return
+                self._socks.append(sock)
+                with self._lock:
+                    for node in self._nodes.values():
+                        if node.addr == addr:
+                            node.err = None
 
     def close(self) -> None:
         """Unsubscribe (best-effort END) and drop every connection."""

@@ -77,6 +77,10 @@ EVENT_KINDS = {
                  "episode (count, via, label, shapes)",
     "mem_pressure": "live device-array bytes crossed the configured "
                     "threshold (bytes, threshold, live_arrays)",
+    "journal": "the black-box journal spiller started or stopped "
+               "(action, dir)",
+    "postmortem": "a postmortem bundle was assembled "
+                  "(reason, out, procs, first_fault)",
 }
 
 #: the wire schema's required keys (and the only keys)

@@ -885,6 +885,12 @@ class Defer:
                                    gen=handle._gen,
                                    stalled_s=round(
                                        time.monotonic() - busy, 3))
+                        # a declared-dead deployment is a postmortem
+                        # trigger: assemble the bundle from whatever
+                        # journals exist (no-op unless journaling)
+                        from ..obs.postmortem import maybe_autopsy
+                        maybe_autopsy("watchdog: deployment declared "
+                                      "dead")
                         handle.error = TimeoutError(
                             f"pipeline dispatch made no progress for "
                             f"{wd:.1f}s; deployment declared dead")

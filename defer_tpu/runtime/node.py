@@ -3167,7 +3167,8 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
               trace_sample_every: int = 0,
               plan=None, graph=None,
               report_interval_ms: float = 250.0,
-              failover: bool = False) -> list[np.ndarray]:
+              failover: bool = False,
+              journal_dir: str | None = None) -> list[np.ndarray]:
     """Export, spawn one OS process per stage REPLICA, stream, tear down.
 
     ``failover=True`` arms the seq-replay substrate
@@ -3265,6 +3266,14 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
     live rows, the detected bottleneck stage, and any straggler flags;
     pass ``graph`` too and the row gains a ``replan`` suggestion from
     :func:`defer_tpu.plan.replan.replan` fed with the live measurements.
+
+    ``journal_dir`` arms the black-box flight recorder
+    (docs/OBSERVABILITY.md): every child boots with ``--journal-dir``
+    so each stage process — and this dispatcher process — spills its
+    events/snapshots/spans to a crash-safe on-disk journal under the
+    directory, a failover respawn auto-assembles a postmortem bundle
+    naming the first fault, and any ``run_chain`` failure does the
+    same synchronously before the error propagates.
 
     ``env`` overrides the child environment.  By default children are
     pinned to the CPU backend: a local chain is a topology demonstration,
@@ -3428,30 +3437,63 @@ def run_chain(stages: Sequence, params: dict[str, Any], inputs,
             paths = export_pipeline(stages, params, artifact_dir,
                                     batch=batch)
 
+        started_journal = False
+        if journal_dir is not None:
+            # the dispatcher is a fleet member too: its events
+            # (replica_respawn, watchdog, stream lifecycle) are the
+            # forensic spine of a postmortem bundle
+            from ..obs.journal import active_journal, start_journal
+            if active_journal() is None:
+                start_journal(journal_dir, "dispatcher")
+                started_journal = True
+
         last_exc: BaseException | None = None
-        for attempt in range(max(1, spawn_retries)):
-            try:
-                return _chain_attempt(
-                    stages, params, inputs, batch=batch, codec=codec,
-                    codec_of=codec_of, r_of=r_of, paths=paths,
-                    in_band=in_band, tuning=tuning, child_env=child_env,
-                    artifact_dir=artifact_dir, rx_depth=rx_depth,
-                    tx_depth=tx_depth, stats_out=stats_out,
-                    on_spawn=on_spawn,
-                    trace_sample_every=trace_sample_every,
-                    plan=plan, graph=graph,
-                    report_interval_ms=report_interval_ms,
-                    coloc=coloc, tier_of=tier_of, tier=tier,
-                    delay_of=delay_of, device_map=device_map,
-                    failover=failover)
-            except _BindRace as e:
-                last_exc = e
-                print(f"run_chain: bind race on attempt {attempt + 1} "
-                      f"({e}); retrying on fresh ports", file=sys.stderr,
-                      flush=True)
-        raise RuntimeError(
-            f"chain spawn lost the port race {spawn_retries} times: "
-            f"{last_exc}") from last_exc
+        try:
+            for attempt in range(max(1, spawn_retries)):
+                try:
+                    return _chain_attempt(
+                        stages, params, inputs, batch=batch, codec=codec,
+                        codec_of=codec_of, r_of=r_of, paths=paths,
+                        in_band=in_band, tuning=tuning,
+                        child_env=child_env,
+                        artifact_dir=artifact_dir, rx_depth=rx_depth,
+                        tx_depth=tx_depth, stats_out=stats_out,
+                        on_spawn=on_spawn,
+                        trace_sample_every=trace_sample_every,
+                        plan=plan, graph=graph,
+                        report_interval_ms=report_interval_ms,
+                        coloc=coloc, tier_of=tier_of, tier=tier,
+                        delay_of=delay_of, device_map=device_map,
+                        failover=failover, journal_dir=journal_dir)
+                except _BindRace as e:
+                    last_exc = e
+                    print(f"run_chain: bind race on attempt "
+                          f"{attempt + 1} ({e}); retrying on fresh "
+                          f"ports", file=sys.stderr, flush=True)
+            raise RuntimeError(
+                f"chain spawn lost the port race {spawn_retries} times: "
+                f"{last_exc}") from last_exc
+        except _BindRace:
+            raise
+        except BaseException as e:
+            if journal_dir is not None:
+                # the failure IS the postmortem trigger: final-spill
+                # this process's journal, then assemble the bundle
+                # synchronously — the stage journals are already on
+                # disk whether their processes died or were killed
+                from ..obs.journal import stop_journal
+                from ..obs.postmortem import maybe_autopsy
+                if started_journal:
+                    stop_journal()
+                    started_journal = False
+                maybe_autopsy(f"run_chain: {type(e).__name__}: {e}",
+                              journal_dir=journal_dir, sync=True,
+                              delay_s=0.0)
+            raise
+        finally:
+            if started_journal:
+                from ..obs.journal import stop_journal
+                stop_journal()
     finally:
         if tmp is not None:
             tmp.cleanup()
@@ -3509,7 +3551,7 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                    trace_sample_every=0, plan=None, graph=None,
                    report_interval_ms=250.0, coloc=None, tier_of=None,
                    tier="tcp", delay_of=None, device_map=None,
-                   failover=False):
+                   failover=False, journal_dir=None):
     """One spawn -> deploy -> stream -> teardown attempt (see
     ``run_chain``).  Raises :class:`_BindRace` when a child died with an
     address-in-use failure; any other failure surfaces the dead node's
@@ -3561,6 +3603,8 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
             flags += ["--infer-delay-ms", str(delay_of[k] * 1e3)]
         if device_map and device_map.get(k) is not None:
             flags += ["--device", str(device_map[k])]
+        if journal_dir is not None:
+            flags += ["--journal-dir", journal_dir]
         return flags
 
     #: spawn units: one OS process each, hosting >= 1 (stage, replica)
@@ -3694,6 +3738,17 @@ def _chain_attempt(stages, params, inputs, *, batch, codec, codec_of,
                             print(f"run_chain: respawned "
                                   f"{stage_label(k, j)} (rc={rc})",
                                   file=sys.stderr, flush=True)
+                            if journal_dir is not None:
+                                # a failover episode auto-emits its
+                                # forensics bundle (rate-limited; the
+                                # delay lets this respawn event reach
+                                # the journals first)
+                                from ..obs.postmortem import \
+                                    maybe_autopsy
+                                maybe_autopsy(
+                                    f"failover: respawned "
+                                    f"{stage_label(k, j)} rc={rc}",
+                                    journal_dir=journal_dir)
 
                 super_thread = threading.Thread(
                     target=_supervise, daemon=True,

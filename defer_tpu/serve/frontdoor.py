@@ -784,6 +784,10 @@ class ServeFrontDoor:
             if self._shed_unit(u, retry_s):
                 shed += 1
         emit_event("backend_lost", error=type(err).__name__, shed=shed)
+        # backend loss is the serve plane's first-class failure: emit
+        # the forensics bundle (no-op unless this process journals)
+        from ..obs.postmortem import maybe_autopsy
+        maybe_autopsy(f"backend_lost: {type(err).__name__}")
 
     def _shed_unit(self, unit: _Unit, retry_s: float) -> bool:
         """Settle one in-flight unit as shed (backend lost): release its

@@ -1,0 +1,298 @@
+"""Black-box journal (obs/journal.py) + postmortem (obs/postmortem.py):
+crash-safety of the on-disk format — torn final writes, ring rotation
+under the size cap, kill -9 mid-spill — and the offline collector's
+alignment, first-fault verdict, and loud-partial-bundle behavior."""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+from defer_tpu.obs import (collect_postmortem, read_journal,
+                           read_process_journals, start_journal,
+                           stop_journal)
+from defer_tpu.obs.events import emit
+from defer_tpu.obs.journal import (JOURNAL_VERSION, JournalWriter,
+                                   read_segment)
+
+_HDR = struct.Struct("<II")
+
+
+def _write_journal(root, proc, *records, pid=None):
+    """One on-disk journal with controlled records.  The writer's own
+    meta + real anchor come first (delta ~0: the tracer timeline IS
+    wall-anchored), then the synthetic records."""
+    w = JournalWriter(root, proc, pid=pid)
+    for r in records:
+        w.append(r)
+    w.flush()
+    w.close()
+    return w
+
+
+def _ev(proc, seq, t_us, kind="admit", **data):
+    return {"proc": proc, "seq": seq, "t_us": t_us, "kind": kind,
+            "data": data}
+
+
+# ---------------------------------------------------------------------------
+# writer/reader round trip + torn writes
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_and_self_describing_meta(tmp_path):
+    root = str(tmp_path)
+    w = JournalWriter(root, "stage1.r0", pid=4242)
+    w.append({"rec": "events", "t_us": 10,
+              "events": [_ev("stage1.r0", 0, 10)], "dropped": 0})
+    w.flush()
+    w.close()
+    j = read_journal(w.dir)
+    assert j["proc"] == "stage1.r0" and j["pid"] == 4242
+    assert j["version"] == JOURNAL_VERSION
+    assert not j["truncated"] and not j["warnings"]
+    kinds = [r["rec"] for r in j["records"]]
+    assert kinds[:2] == ["meta", "anchor"]      # every segment leads
+    assert "events" in kinds
+
+
+def test_torn_final_write_truncates_at_the_tear(tmp_path):
+    w = JournalWriter(str(tmp_path), "p")
+    for i in range(5):
+        w.append({"rec": "events", "t_us": i,
+                  "events": [_ev("p", i, i)], "dropped": 0})
+    w.flush()
+    w.close()
+    seg = w.segments()[-1][0]
+    whole = len(read_segment(seg)[0])
+    # a kill -9 mid-write leaves a half-record: short payload
+    with open(seg, "ab") as fh:
+        fh.write(_HDR.pack(123, 999) + b"{\"rec")
+    records, truncated = read_segment(seg)
+    assert truncated and len(records) == whole
+
+    # ... or a full-length payload whose bytes lie (CRC mismatch)
+    payload = b'{"rec":"events"}'
+    with open(seg, "ab") as fh:
+        fh.write(_HDR.pack((zlib.crc32(payload) ^ 1) & 0xFFFFFFFF,
+                           len(payload)) + payload)
+    records2, truncated2 = read_segment(seg)
+    assert truncated2 and len(records2) == whole
+
+    # the journal-level reader reports the tear but keeps the story
+    j = read_journal(w.dir)
+    assert j["truncated"]
+    assert len([r for r in j["records"] if r["rec"] == "events"]) == 5
+
+
+def test_mid_ring_tear_warns_about_lost_evidence(tmp_path):
+    w = JournalWriter(str(tmp_path), "p", segment_bytes=4096)
+    blob = "x" * 600
+    while w._seg_seq < 3:          # force >= 2 closed segments
+        w.append({"rec": "events", "t_us": 0, "events": [], "pad": blob})
+    w.flush()
+    w.close()
+    first_seg = w.segments()[0][0]
+    with open(first_seg, "r+b") as fh:
+        fh.seek(20)
+        fh.write(b"\xff\xff\xff\xff")          # corrupt mid-segment
+    j = read_journal(w.dir)
+    assert j["truncated"]
+    assert any("torn mid-ring" in wmsg for wmsg in j["warnings"])
+
+
+def test_segment_ring_rotates_and_caps(tmp_path):
+    w = JournalWriter(str(tmp_path), "p", segment_bytes=4096,
+                      max_bytes=4096 * 2)
+    blob = "y" * 200
+    for i in range(200):
+        w.append({"rec": "events", "t_us": i, "events": [], "pad": blob})
+    w.flush()
+    w.close()
+    assert w.segments_dropped > 0
+    live = w.segments()
+    assert sum(sz for _, sz in live) <= 4096 * 2 + 4096  # cap + active
+    # the survivors still read clean, and every segment self-describes
+    j = read_journal(w.dir)
+    assert j["version"] == JOURNAL_VERSION and not j["truncated"]
+    metas = [r for r in j["records"] if r["rec"] == "meta"]
+    assert len(metas) == len(live)
+
+
+def test_kill9_mid_spill_leaves_readable_journal(tmp_path):
+    """An actual SIGKILL between flushes: whatever reached the kernel
+    is a readable journal; the collector explains it without any live
+    process."""
+    root = str(tmp_path / "j")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from defer_tpu.obs import start_journal\n"
+        "from defer_tpu.obs.events import emit\n"
+        f"start_journal({root!r}, 'victim', interval_s=0.05)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    emit('admit', rid=i); i += 1\n"
+        "    time.sleep(0.01)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", child], env=env)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            js = read_process_journals(root)
+            if js and any(r["rec"] == "events" for r in js[0]["records"]):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("victim never spilled an events record")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    js = read_process_journals(root)
+    assert len(js) == 1 and js[0]["proc"] == "victim"
+    evs = [e for r in js[0]["records"] if r["rec"] == "events"
+           for e in r["events"]]
+    assert evs and evs[0]["kind"] == "journal"  # boot event included
+    bundle = collect_postmortem(root, out_dir=str(tmp_path / "b"),
+                                reason="test kill9")
+    assert [p["proc"] for p in bundle["procs"]] == ["victim"]
+    assert bundle["timeline"]
+
+
+# ---------------------------------------------------------------------------
+# the spiller singleton
+# ---------------------------------------------------------------------------
+
+def test_spiller_writes_events_and_snapshots(tmp_path):
+    root = str(tmp_path)
+    try:
+        start_journal(root, "unit", interval_s=0.05, snapshot_every=1,
+                      snapshot_fn=lambda: {"rows": 1})
+        emit("admit", rid=1)
+        time.sleep(0.3)
+    finally:
+        stop_journal()
+    stop_journal()                              # idempotent
+    js = read_process_journals(root)
+    assert len(js) == 1
+    recs = js[0]["records"]
+    kinds = {r["rec"] for r in recs}
+    assert {"meta", "anchor", "events", "snapshot"} <= kinds
+    snap = [r for r in recs if r["rec"] == "snapshot"][-1]
+    assert snap["payload"] == {"rows": 1}
+    emitted = [e for r in recs if r["rec"] == "events"
+               for e in r["events"]]
+    assert any(e["kind"] == "admit" and e["data"].get("rid") == 1
+               for e in emitted)
+
+
+# ---------------------------------------------------------------------------
+# postmortem: partial bundles, alignment, verdict
+# ---------------------------------------------------------------------------
+
+def test_missing_and_empty_roots_yield_loud_partial_bundle(tmp_path):
+    bundle = collect_postmortem(str(tmp_path / "nope"),
+                                out_dir=str(tmp_path / "b1"))
+    assert any("PARTIAL BUNDLE" in w for w in bundle["warnings"])
+    assert bundle["procs"] == [] and bundle["timeline"] == []
+    assert os.path.exists(os.path.join(str(tmp_path / "b1"),
+                                       "bundle.json"))
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    bundle2 = collect_postmortem(str(empty), out_dir=str(tmp_path / "b2"))
+    assert any("PARTIAL BUNDLE" in w for w in bundle2["warnings"])
+
+    # a proc dir with no segments: a named warning, not a crash
+    (empty / "ghost@7").mkdir()
+    bundle3 = collect_postmortem(str(empty), out_dir=str(tmp_path / "b3"))
+    assert any("no segments" in w for w in bundle3["warnings"])
+
+
+def test_alignment_uses_last_anchor_delta(tmp_path):
+    """Events stamped on a skewed process clock land on the wall axis:
+    the LAST anchor's wall_us - t_us shifts everything."""
+    root = str(tmp_path)
+    w = JournalWriter(root, "skewed")
+    delta = 5_000_000
+    w.append({"rec": "anchor", "t_us": 1_000, "wall_us": 1_000 + delta})
+    w.append({"rec": "events", "t_us": 2_000,
+              "events": [_ev("skewed", 0, 1_500)], "dropped": 0})
+    w.flush()
+    w.close()
+    bundle = collect_postmortem(root, out_dir=str(tmp_path / "b"))
+    p = bundle["procs"][0]
+    assert p["delta_us"] == delta
+    ev = [e for e in bundle["timeline"] if e["kind"] == "admit"][0]
+    assert ev["t_us"] == 1_500 + delta
+
+
+def test_verdict_names_first_fault_and_ranks_casualties(tmp_path):
+    """Three dead journals: stage1 stops 5s early, stage0's final
+    snapshot shows a saturated tx watermark (backed up), stage2
+    starved downstream.  The verdict must blame stage1 from the
+    journal-stop evidence and rank stage2 (downstream) first."""
+    root = str(tmp_path)
+    base = time.time_ns() // 1_000
+    _write_journal(root, "stage1", {
+        "rec": "events", "t_us": base,
+        "events": [_ev("stage1", 0, base)], "dropped": 0})
+    _write_journal(root, "stage0", {
+        "rec": "events", "t_us": base + 5_000_000,
+        "events": [_ev("stage0", 0, base + 5_000_000)], "dropped": 0,
+    }, {
+        "rec": "snapshot", "t_us": base + 5_000_000,
+        "payload": {"queues": {"tx_depth": 8, "tx_hi": 8,
+                               "rx_depth": 8, "rx_hi": 0}}})
+    _write_journal(root, "stage2", {
+        "rec": "events", "t_us": base + 5_000_000,
+        "events": [_ev("stage2", 0, base + 5_000_000)], "dropped": 0})
+    bundle = collect_postmortem(root, out_dir=str(tmp_path / "b"),
+                                reason="unit")
+    v = bundle["verdict"]
+    assert v["first_fault"] == "stage1"
+    assert any("stops at" in e for e in v["evidence"])
+    cas = v["casualties"]
+    assert [c["proc"] for c in cas] == ["stage2", "stage0"]
+    assert cas[0]["role"] == "downstream"
+    assert cas[1]["role"] == "upstream"
+    assert cas[1]["saturated"] == ["tx watermark 8/8"]
+    # the bundle hit disk: json + perfetto trace
+    out = bundle["out_dir"]
+    doc = json.load(open(os.path.join(out, "trace.json")))
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert names == {"stage0", "stage1", "stage2"}
+
+
+def test_fatal_event_names_victim_when_no_journal_stopped(tmp_path):
+    """A respawned replica keeps journaling, so nothing 'stops' — the
+    supervisor's replica_respawn event carries the blame instead."""
+    root = str(tmp_path)
+    base = time.time_ns() // 1_000
+    _write_journal(root, "dispatcher", {
+        "rec": "events", "t_us": base,
+        "events": [_ev("dispatcher", 0, base, kind="replica_respawn",
+                       stage=1, replica=0, rc=-9)], "dropped": 0})
+    bundle = collect_postmortem(root, out_dir=str(tmp_path / "b"))
+    v = bundle["verdict"]
+    assert v["first_fault"] == "stage1.r0"
+    assert v["fatal_event"]["kind"] == "replica_respawn"
+
+
+def test_evidence_gap_warning_on_dropped_events(tmp_path):
+    root = str(tmp_path)
+    _write_journal(root, "p", {
+        "rec": "events", "t_us": 50,
+        "events": [_ev("p", 9, 50)], "dropped": 7})
+    bundle = collect_postmortem(root, out_dir=str(tmp_path / "b"))
+    assert bundle["events_dropped"] == 7
+    assert bundle["verdict"]["events_dropped"] == 7
+    assert any("EVIDENCE GAP" in w for w in bundle["warnings"])
